@@ -1,0 +1,127 @@
+"""Launcher / CLI entry point.
+
+Plays the role of the reference's SageMaker container entry
+(ref: main.py:9-84): parse flags, build CIFAR-10 datasets (optionally with
+the custom preprocess pipeline), construct the Trainer and run ``fit()``.
+TPU-native differences:
+
+* ``--backend tpu`` replaces the SageMaker estimator/SMDDP path — on a TPU
+  VM (single- or multi-host) the same command runs everywhere; multi-host
+  rendezvous happens through ``jax.distributed`` env auto-detection instead
+  of SageMaker's MPI-style env (ref: main.py:80-83).
+* The SageMaker env vars (SM_MODEL_DIR, SM_CHANNEL_TRAIN) are still honored
+  as defaults when present, so an estimator-style launch keeps working.
+* ``--batch_size`` / ``--epochs`` are honored.  The reference parses them
+  but hardcodes 32/250 (ref: main.py:44) — a bug we deliberately fix.
+* ``--custom_function`` is a real boolean flag.  The reference declares it
+  ``type=bool`` which makes any non-empty string truthy (ref: main.py:74-75)
+  — fixed.
+* ``--model`` selects from the model zoo (the reference hardcodes MLModel,
+  ref: main.py:30); ``--synthetic`` substitutes deterministic synthetic data
+  for environments without the dataset on disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from ml_trainer_tpu import Trainer
+from ml_trainer_tpu.data.datasets import CIFAR10, SyntheticCIFAR10
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+
+def build_datasets(args):
+    transform = custom_pre_process_function() if args.custom_function else None
+    if args.synthetic:
+        return (
+            SyntheticCIFAR10(size=args.synthetic_train_size, transform=transform),
+            SyntheticCIFAR10(size=args.synthetic_val_size, transform=transform, seed=1),
+        )
+    return (
+        CIFAR10(root=args.data_dir, train=True, transform=transform),
+        CIFAR10(root=args.data_dir, train=False, transform=transform),
+    )
+
+
+def main(args) -> None:
+    datasets = build_datasets(args)
+    model = get_model(args.model)
+    config = {
+        "seed": args.seed,
+        "scheduler": args.scheduler,
+        "optimizer": args.optimizer,
+        "momentum": args.momentum,
+        "weight_decay": args.weight_decay,
+        "lr": args.lr,
+        "criterion": args.criterion,
+        "pred_function": args.pred_function,
+        "metric": args.metric,
+        "model_dir": args.model_dir,
+        "backend": args.backend,
+    }
+    trainer = Trainer(
+        model,
+        datasets=datasets,
+        epochs=args.epochs,
+        batch_size=args.batch_size,
+        is_parallel=args.is_parallel,
+        save_history=True,
+        **config,
+    )
+    trainer.fit(resume=args.resume)
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    # Training flags — same names/defaults as ref: main.py:52-77.
+    parser.add_argument("--batch_size", type=int, default=32,
+                        help="global batch size for training (default: 32)")
+    parser.add_argument("--epochs", type=int, default=10,
+                        help="number of epochs to train (default: 10)")
+    parser.add_argument("--optimizer", type=str, default="sgd",
+                        help="optimizer for the update step (default: sgd)")
+    parser.add_argument("--lr", type=float, default=0.001,
+                        help="learning rate (default: 0.001)")
+    parser.add_argument("--momentum", type=float, default=0.9,
+                        help="optimizer momentum (default: 0.9)")
+    parser.add_argument("--weight_decay", type=float, default=0.0,
+                        help="optimizer weight decay (default: 0.0)")
+    parser.add_argument("--seed", type=int, default=32,
+                        help="random seed (default: 32)")
+    parser.add_argument("--scheduler", type=str, default=None,
+                        help="LR scheduler name (default: None)")
+    parser.add_argument("--criterion", type=str, default="cross_entropy",
+                        help="loss function (default: cross_entropy)")
+    parser.add_argument("--metric", type=str, default=None,
+                        help="evaluation metric (default: None)")
+    parser.add_argument("--backend", type=str, default="tpu",
+                        help="communication backend: tpu | cpu "
+                             "(smddp/nccl/gloo accepted as aliases)")
+    parser.add_argument("--custom_function", action="store_true",
+                        help="apply the custom preprocess pipeline")
+    parser.add_argument("--pred_function", type=str, default=None,
+                        help="probability function for predictions")
+    # TPU-native additions.
+    parser.add_argument("--model", type=str, default="mlmodel",
+                        help="model zoo name (default: mlmodel)")
+    parser.add_argument("--is_parallel", action="store_true",
+                        help="train data-parallel over the full device mesh")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from the latest full checkpoint")
+    parser.add_argument("--synthetic", action="store_true",
+                        help="use deterministic synthetic CIFAR-10 data")
+    parser.add_argument("--synthetic_train_size", type=int, default=2048)
+    parser.add_argument("--synthetic_val_size", type=int, default=512)
+    # SageMaker-compatible env-backed paths (ref: main.py:80-83), with sane
+    # defaults when the env vars are absent.
+    parser.add_argument("--model_dir", type=str,
+                        default=os.environ.get("SM_MODEL_DIR", "model_output"))
+    parser.add_argument("--data_dir", type=str,
+                        default=os.environ.get("SM_CHANNEL_TRAIN", "data"))
+    return parser.parse_args(argv)
+
+
+if __name__ == "__main__":
+    main(parse_args())

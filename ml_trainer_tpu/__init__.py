@@ -1,0 +1,43 @@
+"""ml_trainer_tpu — a TPU-native training framework (JAX / XLA / pjit / Pallas).
+
+Brand-new implementation with the capabilities of the reference trainer
+(abbomarengo/ml-trainer): a config-driven ``Trainer`` with pluggable
+optimizers, LR schedules, losses, metrics and prediction functions
+(ref: src/trainer.py:22-311), a ``Loader`` data abstraction
+(ref: src/dataloader.py:5), the LeNet-style ``MLModel``
+(ref: src/model.py:7-24) plus a TPU model zoo, and history/checkpoint
+utilities (ref: src/utils/utils.py:9-68) — all built mesh-first:
+
+* the train step is a single compiled XLA program (``jax.jit`` under a
+  ``jax.sharding.Mesh``) whose gradient all-reduce is a ``psum`` over the
+  ICI/DCN mesh — the TPU-native equivalent of the reference's
+  DistributedDataParallel + SMDDP stack (ref: src/trainer.py:98, 43-44);
+* the input pipeline shards the global batch across hosts and
+  double-buffers device transfers (the DistributedSampler + DataLoader
+  analog, ref: src/trainer.py:60-64, 77-79);
+* checkpointing saves full training state (params, optimizer state, step,
+  PRNG key) and supports resume — a deliberate extension over the
+  reference's save-only weights path (ref: src/trainer.py:232-235).
+"""
+
+from ml_trainer_tpu.config import TrainerConfig, validate_kwargs
+from ml_trainer_tpu.trainer import Trainer
+from ml_trainer_tpu.data import Loader, ArrayDataset, ShardedSampler
+from ml_trainer_tpu.models import MLModel
+from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Trainer",
+    "TrainerConfig",
+    "validate_kwargs",
+    "Loader",
+    "ArrayDataset",
+    "ShardedSampler",
+    "MLModel",
+    "load_history",
+    "load_model",
+    "plot_history",
+    "__version__",
+]

@@ -1,0 +1,23 @@
+from ml_trainer_tpu.checkpoint.checkpoint import (
+    CHECKPOINT_PREFIX,
+    MODEL_FILE,
+    latest_checkpoint,
+    load_model_variables,
+    prune_checkpoints,
+    restore_checkpoint,
+    save_checkpoint,
+    save_model_variables,
+)
+from ml_trainer_tpu.checkpoint.torch_import import load_torch_checkpoint
+
+__all__ = [
+    "CHECKPOINT_PREFIX",
+    "MODEL_FILE",
+    "latest_checkpoint",
+    "load_model_variables",
+    "prune_checkpoints",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "save_model_variables",
+    "load_torch_checkpoint",
+]

@@ -1,0 +1,108 @@
+"""Checkpoint I/O: per-epoch model export + full training-state save/resume.
+
+The reference saves only model weights every epoch on rank 0 and pickles the
+history once at the end (ref: src/trainer.py:232-241, 252-256); ``fit()``
+cannot resume.  Here both layers exist:
+
+* ``save_model_variables`` / ``load_model_variables`` — weights-only export
+  (``model.msgpack``, the ``model.pth`` analog) for inference and the
+  03-notebook flow.
+* ``save_checkpoint`` / ``restore_checkpoint`` — the full TrainState
+  (params, optimizer state, step, PRNG key, batch_stats) plus history, so a
+  preempted TPU job resumes exactly — the deliberate extension called out in
+  SURVEY.md §5.
+
+Writes are atomic (tmp + rename) and host-0-only at the call sites, matching
+the reference's rank-0 gate (ref: src/trainer.py:252-254).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+from flax import serialization
+
+MODEL_FILE = "model.msgpack"
+CHECKPOINT_PREFIX = "checkpoint_"
+_CKPT_RE = re.compile(rf"^{CHECKPOINT_PREFIX}(\d+)\.pkl$")
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fp:
+        fp.write(data)
+    os.replace(tmp, path)
+
+
+def save_model_variables(model_dir: str, variables: Any) -> str:
+    """Weights-only export, every-epoch cadence (ref: src/trainer.py:232-235)."""
+    os.makedirs(model_dir, exist_ok=True)
+    path = os.path.join(model_dir, MODEL_FILE)
+    _atomic_write(path, serialization.to_bytes(jax.device_get(variables)))
+    return path
+
+
+def load_model_variables(path: str) -> Any:
+    """Template-free restore of a ``model.msgpack`` into nested dicts."""
+    if os.path.isdir(path):
+        path = os.path.join(path, MODEL_FILE)
+    with open(path, "rb") as fp:
+        return serialization.msgpack_restore(fp.read())
+
+
+def save_checkpoint(
+    ckpt_dir: str,
+    state: Any,
+    history: dict,
+    epoch: int,
+    keep: int = 3,
+) -> str:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = {
+        "state": serialization.to_state_dict(jax.device_get(state)),
+        "history": history,
+        "epoch": epoch,
+    }
+    path = os.path.join(ckpt_dir, f"{CHECKPOINT_PREFIX}{epoch}.pkl")
+    _atomic_write(path, pickle.dumps(payload))
+    prune_checkpoints(ckpt_dir, keep)
+    return path
+
+
+def _scan_checkpoints(ckpt_dir: str):
+    """Sorted (epoch, filename) pairs of checkpoints in a directory."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    found = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            found.append((int(m.group(1)), name))
+    return sorted(found)
+
+
+def prune_checkpoints(ckpt_dir: str, keep: int) -> None:
+    if not keep:
+        return
+    for _, name in _scan_checkpoints(ckpt_dir)[:-keep]:
+        os.remove(os.path.join(ckpt_dir, name))
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    found = _scan_checkpoints(ckpt_dir)
+    if not found:
+        return None
+    return os.path.join(ckpt_dir, found[-1][1])
+
+
+def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:
+    """Restore (state, history, epoch); the template supplies pytree
+    structure (the trainer always has one before restoring)."""
+    with open(path, "rb") as fp:
+        payload = pickle.load(fp)
+    state = serialization.from_state_dict(state_template, payload["state"])
+    return state, payload["history"], payload["epoch"]

@@ -1,0 +1,84 @@
+"""Import reference torch checkpoints into flax parameter trees.
+
+Replicates the compatibility behaviour of the reference's ``load_model``
+(ref: src/utils/utils.py:15-28): DDP-saved state dicts carry a ``module.``
+prefix which is stripped, falling back to a direct load.  On top of that,
+layouts are converted for the TPU-native models:
+
+* conv weights: torch OIHW -> flax HWIO;
+* linear weights: torch (out, in) -> flax (in, out);
+* the first dense layer after a conv stack additionally permutes its input
+  features from torch's C·H·W flatten order to this framework's H·W·C order
+  (``spatial_inputs`` maps layer name -> (C, H, W); MLModel's ``fc1`` is
+  (16, 5, 5), ref: src/model.py:11, 20).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+# MLModel's fc1 consumes the flattened 16x5x5 conv output (ref: src/model.py:11).
+MLMODEL_SPATIAL_INPUTS = {"fc1": (16, 5, 5)}
+
+
+def _strip_ddp_prefix(state_dict: Mapping[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Remove the DDP ``module.`` prefix when every key carries it
+    (ref: src/utils/utils.py:17-27's try/except, made explicit)."""
+    keys = list(state_dict)
+    if keys and all(k.startswith("module.") for k in keys):
+        return {k[len("module."):]: v for k, v in state_dict.items()}
+    return dict(state_dict)
+
+
+def convert_torch_state_dict(
+    state_dict: Mapping[str, np.ndarray],
+    spatial_inputs: Optional[Dict[str, Tuple[int, int, int]]] = None,
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """torch ``{layer.weight/bias: tensor}`` -> flax ``{layer: {kernel/bias}}``."""
+    spatial_inputs = (
+        MLMODEL_SPATIAL_INPUTS if spatial_inputs is None else spatial_inputs
+    )
+    state_dict = _strip_ddp_prefix(state_dict)
+    params: Dict[str, Dict[str, np.ndarray]] = {}
+    for key, value in state_dict.items():
+        arr = np.asarray(value)
+        layer, _, leaf = key.rpartition(".")
+        layer = layer.replace(".", "/")
+        entry = params.setdefault(layer, {})
+        if leaf == "weight":
+            if arr.ndim == 4:  # OIHW -> HWIO
+                entry["kernel"] = arr.transpose(2, 3, 1, 0)
+            elif arr.ndim == 2:
+                if layer in spatial_inputs:
+                    c, h, w = spatial_inputs[layer]
+                    arr = (
+                        arr.reshape(arr.shape[0], c, h, w)
+                        .transpose(0, 2, 3, 1)
+                        .reshape(arr.shape[0], c * h * w)
+                    )
+                entry["kernel"] = arr.T
+            else:
+                entry["scale" if arr.ndim == 1 else "kernel"] = arr
+        elif leaf == "bias":
+            entry["bias"] = arr
+        elif leaf in ("running_mean", "running_var"):
+            entry["mean" if leaf == "running_mean" else "var"] = arr
+        elif leaf == "num_batches_tracked":
+            continue
+        else:
+            entry[leaf] = arr
+    return params
+
+
+def load_torch_checkpoint(
+    path: str,
+    spatial_inputs: Optional[Dict[str, Tuple[int, int, int]]] = None,
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """Load a reference ``model.pth`` into flax params (torch-cpu only)."""
+    import torch
+
+    state_dict = torch.load(path, map_location="cpu", weights_only=True)
+    state_dict = {k: v.numpy() for k, v in state_dict.items()}
+    return convert_torch_state_dict(state_dict, spatial_inputs)

@@ -1,0 +1,93 @@
+"""Config system.
+
+The reference exposes a kwargs whitelist of exactly eleven keys enforced by
+``validate_kwargs`` (ref: src/trainer.py:26-28, 307-311) with defaults
+unpacked via ``config.get`` (ref: src/trainer.py:30-41).  This module keeps
+that public surface — the same key names, defaults, and TypeError behaviour —
+but expresses it as one dataclass so every component (CLI, notebooks,
+Trainer) shares a single validated source of truth (the reference splits it
+across argparse defaults, notebook hyperparameter dicts and the Trainer).
+
+Deliberate divergences from the reference (documented, see SURVEY.md §5):
+
+* ``backend`` names TPU-native communication stacks instead of torch process
+  group backends.  The reference's names are accepted as aliases so the
+  02-notebook hyperparameter dict keeps working: ``smddp``/``nccl`` (the GPU
+  collectives, ref: main.py:72-73) map to ``tpu`` (XLA collectives over
+  ICI/DCN) and ``gloo`` (the CPU fallback, ref: main.py:73) maps to ``cpu``
+  (host-platform simulated mesh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+# The exact whitelist from ref: src/trainer.py:26-27.
+ALLOWED_KWARGS = {
+    "seed",
+    "scheduler",
+    "optimizer",
+    "momentum",
+    "weight_decay",
+    "lr",
+    "criterion",
+    "metric",
+    "pred_function",
+    "model_dir",
+    "backend",
+}
+
+# Reference backend strings (ref: main.py:72-73) mapped to TPU-native stacks.
+BACKEND_ALIASES = {
+    "smddp": "tpu",
+    "nccl": "tpu",
+    "gloo": "cpu",
+    "tpu": "tpu",
+    "cpu": "cpu",
+}
+
+
+def validate_kwargs(
+    kwargs: Dict[str, Any],
+    allowed_kwargs,
+    error_message: str = "Keyword argument not understood:",
+) -> None:
+    """Raise ``TypeError`` on unknown config keys (ref: src/trainer.py:307-311)."""
+    for kwarg in kwargs:
+        if kwarg not in allowed_kwargs:
+            raise TypeError(error_message, kwarg)
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    """Validated trainer config — same keys/defaults as ref: src/trainer.py:30-41."""
+
+    seed: int = 32
+    scheduler: Optional[str] = None
+    optimizer: str = "sgd"
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    lr: float = 0.001
+    criterion: str = "cross_entropy"
+    metric: Optional[str] = "accuracy"
+    pred_function: Optional[str] = "softmax"
+    model_dir: str = "model_output"
+    backend: str = "tpu"
+
+    @classmethod
+    def from_kwargs(cls, **config: Any) -> "TrainerConfig":
+        """Build from a reference-style config dict, rejecting unknown keys."""
+        validate_kwargs(config, ALLOWED_KWARGS)
+        out = cls(**config)
+        try:
+            out.backend = BACKEND_ALIASES[out.backend]
+        except KeyError:
+            raise ValueError(
+                f"Unknown backend {out.backend!r}; expected one of "
+                f"{sorted(set(BACKEND_ALIASES))}"
+            ) from None
+        return out
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)

@@ -1,0 +1,39 @@
+"""Host-side input pipeline: datasets, batch transforms, sharded sampling and
+a prefetching ``Loader`` — the TPU-native stand-in for torch's DataLoader +
+DistributedSampler stack (ref: src/dataloader.py:5, src/trainer.py:60-64,
+77-79)."""
+
+from ml_trainer_tpu.data.datasets import (
+    ArrayDataset,
+    CIFAR10,
+    Dataset,
+    SyntheticCIFAR10,
+    SyntheticTokens,
+    as_dataset,
+)
+from ml_trainer_tpu.data.loader import Loader, prefetch_to_device
+from ml_trainer_tpu.data.sampler import ShardedSampler
+from ml_trainer_tpu.data.transforms import (
+    Compose,
+    Normalize,
+    RandomCrop,
+    RandomHorizontalFlip,
+    ToFloat,
+)
+
+__all__ = [
+    "ArrayDataset",
+    "CIFAR10",
+    "Dataset",
+    "SyntheticCIFAR10",
+    "SyntheticTokens",
+    "as_dataset",
+    "Loader",
+    "prefetch_to_device",
+    "ShardedSampler",
+    "Compose",
+    "Normalize",
+    "RandomCrop",
+    "RandomHorizontalFlip",
+    "ToFloat",
+]

@@ -1,0 +1,141 @@
+"""Datasets: array-backed containers with optional batch transforms.
+
+The reference consumes ``torchvision.datasets.CIFAR10`` objects
+(ref: main.py:14-28).  Here the canonical container is ``ArrayDataset`` —
+contiguous numpy arrays, which is what a TPU input pipeline wants (batch
+assembly is a slice, not a Python-object gather).  ``as_dataset`` adapts
+reference-style torch datasets so the 01/02/03 notebook flow still works.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.data.transforms import Transform
+
+
+class Dataset:
+    """Minimal dataset protocol: ``len`` and integer indexing -> (x, y)."""
+
+    transform: Optional[Transform] = None
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, idx: int) -> Tuple[Any, Any]:
+        raise NotImplementedError
+
+
+class ArrayDataset(Dataset):
+    """Dataset over in-memory numpy arrays with an optional *batched*
+    transform (applied by the Loader per batch, not per sample)."""
+
+    def __init__(
+        self,
+        data: np.ndarray,
+        targets: np.ndarray,
+        transform: Optional[Transform] = None,
+    ):
+        assert len(data) == len(targets), (len(data), len(targets))
+        self.data = np.asarray(data)
+        self.targets = np.asarray(targets)
+        self.transform = transform
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx], self.targets[idx]
+
+    def batch(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Fast batched gather — the Loader's hot path."""
+        return self.data[indices], self.targets[indices]
+
+
+class CIFAR10(ArrayDataset):
+    """CIFAR-10 from the standard ``cifar-10-batches-py`` pickle layout on
+    disk (the same files torchvision unpacks; ref: main.py:14-28 uses
+    ``download=False`` too, so on-disk data is the reference contract as
+    well).  Images are stored NHWC uint8; transforms run per batch."""
+
+    def __init__(self, root: str, train: bool = True, transform=None):
+        base = os.path.join(root, "cifar-10-batches-py")
+        if not os.path.isdir(base):
+            raise FileNotFoundError(
+                f"CIFAR-10 pickle batches not found under {base!r}. "
+                "Place the extracted 'cifar-10-batches-py' directory there "
+                "(no download is attempted), or use SyntheticCIFAR10 for "
+                "smoke tests and benchmarks."
+            )
+        files = (
+            [f"data_batch_{i}" for i in range(1, 6)] if train else ["test_batch"]
+        )
+        xs, ys = [], []
+        for name in files:
+            with open(os.path.join(base, name), "rb") as fp:
+                entry = pickle.load(fp, encoding="latin1")
+            xs.append(entry["data"])
+            ys.extend(entry["labels"])
+        data = (
+            np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        )  # NCHW-packed file -> NHWC
+        super().__init__(data, np.asarray(ys, dtype=np.int32), transform)
+
+
+class SyntheticCIFAR10(ArrayDataset):
+    """Deterministic CIFAR-10-shaped random data for tests and benchmarks
+    (stands in for the real dataset in the zero-egress environment)."""
+
+    def __init__(self, size: int = 1024, transform=None, seed: int = 0):
+        rng = np.random.default_rng(seed)
+        data = rng.integers(0, 256, size=(size, 32, 32, 3), dtype=np.uint8)
+        targets = rng.integers(0, 10, size=(size,)).astype(np.int32)
+        super().__init__(data, targets, transform)
+
+
+class SyntheticTokens(ArrayDataset):
+    """Deterministic token-id dataset for LM / encoder smoke tests
+    (the tokenized-dataset path of the BERT/GPT-2 north-star configs)."""
+
+    def __init__(
+        self,
+        size: int = 256,
+        seq_len: int = 128,
+        vocab_size: int = 1024,
+        num_classes: Optional[int] = None,
+        seed: int = 0,
+    ):
+        rng = np.random.default_rng(seed)
+        data = rng.integers(0, vocab_size, size=(size, seq_len)).astype(np.int32)
+        if num_classes is None:
+            # Causal LM: target is the next token.
+            targets = np.roll(data, -1, axis=1)
+        else:
+            targets = rng.integers(0, num_classes, size=(size,)).astype(np.int32)
+        super().__init__(data, targets, None)
+
+
+def as_dataset(ds: Any) -> Dataset:
+    """Adapt foreign datasets (e.g. torchvision CIFAR10 passed by
+    reference-style notebooks) into an ``ArrayDataset``."""
+    if isinstance(ds, Dataset):
+        return ds
+    if hasattr(ds, "data") and hasattr(ds, "targets"):
+        from ml_trainer_tpu.data.transforms import ForeignTransform, Transform
+
+        data = np.asarray(ds.data)
+        if data.ndim == 4 and data.shape[1] in (1, 3) and data.shape[-1] not in (1, 3):
+            data = data.transpose(0, 2, 3, 1)  # NCHW -> NHWC
+        transform = getattr(ds, "transform", None)
+        if transform is not None and not isinstance(transform, Transform):
+            # Foreign per-sample transform (torchvision Compose from the
+            # reference notebooks) — adapt to the batched calling convention.
+            transform = ForeignTransform(transform)
+        return ArrayDataset(data, np.asarray(ds.targets), transform)
+    # Fall back to item-by-item materialization.
+    xs, ys = zip(*[ds[i] for i in range(len(ds))])
+    return ArrayDataset(np.stack([np.asarray(x) for x in xs]), np.asarray(ys))

@@ -1,0 +1,14 @@
+"""Model zoo.
+
+``MLModel`` is the parity model (the reference's LeNet-style CIFAR-10 CNN,
+ref: src/model.py:7-24); the rest are the north-star families from
+BASELINE.json: ResNet-18/50, ViT-B/16, BERT-base, GPT-2-124M — all flax
+modules designed for TPU (NHWC convs, bf16-friendly, attention through
+ops/attention so the Pallas flash kernel and ring sequence parallelism plug
+in uniformly).
+"""
+
+from ml_trainer_tpu.models.mlmodel import MLModel
+from ml_trainer_tpu.models.registry import get_model, register_model, MODELS
+
+__all__ = ["MLModel", "get_model", "register_model", "MODELS"]

@@ -1,0 +1,33 @@
+"""MLModel — the reference's LeNet-style CIFAR-10 CNN, TPU-native.
+
+Architecture parity with ref: src/model.py:7-24:
+Conv(3→6, 5×5, VALID) → ReLU → MaxPool(2,2) → Conv(6→16, 5×5, VALID) → ReLU
+→ MaxPool(2,2) → flatten(400) → Dense(120) → ReLU → Dense(84) → ReLU →
+Dense(10).
+
+TPU-native choices: NHWC layout (XLA's preferred conv layout on TPU; the
+reference is NCHW) and flatten in H,W,C order — the torch-checkpoint
+importer permutes fc1 accordingly (see checkpoint.torch_import)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from ml_trainer_tpu.models.registry import register_model
+
+
+@register_model("mlmodel")
+class MLModel(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Conv(6, (5, 5), padding="VALID", name="conv1")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(16, (5, 5), padding="VALID", name="conv2")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, name="fc2")(x))
+        x = nn.Dense(self.num_classes, name="fc3")(x)
+        return x

@@ -1,0 +1,48 @@
+"""Model registry: string name -> flax module factory.
+
+The reference has exactly one hardcoded model (ref: main.py:30); the
+registry generalizes that to the north-star zoo while keeping
+``Trainer(model=...)`` able to accept either a module instance or a name.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict
+
+import flax.linen as nn
+
+MODELS: Dict[str, Callable[..., nn.Module]] = {}
+
+_FAMILY_MODULES = ("mlmodel", "resnet", "vit", "bert", "gpt2")
+
+
+def register_model(name: str):
+    def deco(factory):
+        MODELS[name] = factory
+        return factory
+
+    return deco
+
+
+def _load_families() -> None:
+    for mod in _FAMILY_MODULES:
+        try:
+            importlib.import_module(f"ml_trainer_tpu.models.{mod}")
+        except ImportError:
+            pass
+
+
+def get_model(name: str, **kwargs) -> nn.Module:
+    _load_families()
+    try:
+        return MODELS[name](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"Unknown model {name!r}; expected one of {sorted(MODELS)}"
+        ) from None
+
+
+def available_models():
+    _load_families()
+    return sorted(MODELS)

@@ -1,0 +1,27 @@
+"""Compute-path building blocks: optimizers, schedules, losses, metrics,
+prediction functions and attention ops.
+
+These are the TPU-native equivalents of the reference's factory methods on
+the Trainer (ref: src/trainer.py:115-172) — split into a proper ops layer so
+they are pure, jit-able functions instead of device-bound torch modules.
+"""
+
+from ml_trainer_tpu.ops.optimizers import get_optimizer, OPTIMIZERS
+from ml_trainer_tpu.ops.schedules import make_lr_schedule, PlateauController, SCHEDULERS
+from ml_trainer_tpu.ops.losses import get_criterion, CRITERIA
+from ml_trainer_tpu.ops.metrics import get_metric, METRICS
+from ml_trainer_tpu.ops.predictions import get_prediction_function, get_predictions
+
+__all__ = [
+    "get_optimizer",
+    "OPTIMIZERS",
+    "make_lr_schedule",
+    "PlateauController",
+    "SCHEDULERS",
+    "get_criterion",
+    "CRITERIA",
+    "get_metric",
+    "METRICS",
+    "get_prediction_function",
+    "get_predictions",
+]

@@ -1,0 +1,53 @@
+"""Metric engine: 'accuracy' and 'mcrmse', computed on-device.
+
+Reference semantics (ref: src/trainer.py:160-166):
+
+* ``accuracy`` — argmax of (pred-fn-transformed) outputs vs integer targets.
+  The reference round-trips through sklearn on the CPU per batch — a device
+  sync we replace with a fused jnp mean-of-equality so metrics ride inside
+  the compiled step and are fetched once per epoch.
+* ``mcrmse`` — mean column-wise RMSE, identical math
+  (ref: src/trainer.py:161-163).
+
+Each metric is (outputs, targets) -> scalar; the prediction function is
+bound at registry time so the trainer treats all metrics uniformly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ml_trainer_tpu.ops.predictions import get_predictions
+
+
+def accuracy(outputs, targets, pred_function: Optional[Callable] = None):
+    predictions = get_predictions(outputs, pred_function)
+    return jnp.mean((predictions == targets).astype(jnp.float32))
+
+
+def mcrmse(outputs, targets, pred_function: Optional[Callable] = None):
+    colwise_mse = jnp.mean(jnp.square(targets - outputs), axis=0)
+    return jnp.mean(jnp.sqrt(colwise_mse), axis=0)
+
+
+METRICS = {
+    "accuracy": accuracy,
+    "mcrmse": mcrmse,
+}
+
+
+def get_metric(
+    name: Optional[str], pred_function: Optional[Callable] = None
+) -> Optional[Callable]:
+    """Bind a metric by name; ``None`` disables metrics (ref: main.py:70-71)."""
+    if name is None:
+        return None
+    try:
+        fn = METRICS[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown metric {name!r}; expected one of {sorted(METRICS)}"
+        ) from None
+    return lambda outputs, targets: fn(outputs, targets, pred_function)

@@ -1,0 +1,32 @@
+"""Prediction-function factory.
+
+TPU-native form of ``Trainer._get_prediction_function`` /
+``Trainer._get_predictions`` (ref: src/trainer.py:115-121, 168-172):
+``softmax`` / ``logsoftmax`` / None applied before an argmax over the last
+axis.  Pure jnp functions so the whole predict path stays on-device — the
+reference's argmax feeds a sklearn metric on host (ref: src/trainer.py:166),
+a per-batch device sync we deliberately avoid.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.nn
+import jax.numpy as jnp
+
+
+def get_prediction_function(name: Optional[str]) -> Optional[Callable]:
+    """'softmax' | 'logsoftmax' | None (ref: src/trainer.py:115-121)."""
+    if name == "logsoftmax":
+        return jax.nn.log_softmax
+    if name == "softmax":
+        return jax.nn.softmax
+    return None
+
+
+def get_predictions(outputs, pred_function: Optional[Callable]):
+    """Argmax of (optionally transformed) outputs (ref: src/trainer.py:168-172)."""
+    if pred_function is not None:
+        return jnp.argmax(pred_function(outputs), axis=-1)
+    return jnp.argmax(outputs, axis=-1)

@@ -1,0 +1,117 @@
+"""LR schedules: string name -> step-indexed schedule function.
+
+The reference eagerly constructs three torch schedulers and steps them from
+the host loop (ref: src/trainer.py:105-112, 189-190, 198-199).  On TPU the
+schedule must live *inside* the compiled step — host-side ``.step()`` calls
+would force a sync per batch — so each schedule here is a pure function of
+the global step count, traced once by XLA.
+
+Name set and hyperparameters match the reference registry
+(ref: src/trainer.py:105-112):
+
+* ``CosineAnnealingWarmRestarts`` — T_0 = 5 epochs, eta_min = 1e-7, stepped
+  per-batch with fractional epoch ``epoch - 1 + i/len(loader)``
+  (ref: src/trainer.py:189-190).  Expressed as lr(step) with
+  ``epoch_frac = step / steps_per_epoch``.
+* ``StepLR`` — step_size = 2 epochs, gamma = 0.1 (torch default), stepped at
+  the end of each training epoch (ref: src/trainer.py:198-199), i.e. during
+  1-indexed epoch e the factor is ``gamma ** ((e - 1) // 2)``.
+* ``ReduceLROnPlateau`` — 'min' mode, min_lr = 1e-7
+  (ref: src/trainer.py:108).  The reference constructs it but **never steps
+  it** (dead code); we fix that deliberately: the base schedule is constant
+  and ``PlateauController`` runs on the host at epoch boundaries (the only
+  place a metric-conditional LR is known), feeding a scalar ``lr_scale``
+  into the compiled step.  Documented divergence.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+SCHEDULERS = ("CosineAnnealingWarmRestarts", "ReduceLROnPlateau", "StepLR")
+
+
+def make_lr_schedule(
+    scheduler_type: Optional[str],
+    base_lr: float,
+    steps_per_epoch: int,
+) -> Callable:
+    """Build lr(step).  ``scheduler_type=None`` -> constant (ref default)."""
+    steps_per_epoch = max(int(steps_per_epoch), 1)
+
+    if scheduler_type is None:
+        return lambda step: jnp.asarray(base_lr, dtype=jnp.float32)
+
+    if scheduler_type == "CosineAnnealingWarmRestarts":
+        t0_epochs = 5.0
+        eta_min = 1e-7
+
+        def cosine_restarts(step):
+            epoch_frac = step / steps_per_epoch
+            t_cur = jnp.mod(epoch_frac, t0_epochs) / t0_epochs
+            return eta_min + (base_lr - eta_min) * 0.5 * (
+                1.0 + jnp.cos(jnp.pi * t_cur)
+            )
+
+        return cosine_restarts
+
+    if scheduler_type == "StepLR":
+        step_size_epochs = 2
+        gamma = 0.1
+
+        def step_lr(step):
+            epoch = step // steps_per_epoch  # 0-indexed epoch in progress
+            return base_lr * gamma ** (epoch // step_size_epochs)
+
+        return step_lr
+
+    if scheduler_type == "ReduceLROnPlateau":
+        # Constant base; runtime reduction comes from PlateauController via
+        # the lr_scale argument of the train step.
+        return lambda step: jnp.asarray(base_lr, dtype=jnp.float32)
+
+    raise ValueError(
+        f"Unknown scheduler {scheduler_type!r}; expected one of {SCHEDULERS}"
+    )
+
+
+class PlateauController:
+    """Host-side ReduceLROnPlateau (torch defaults: factor 0.1, patience 10,
+    rel threshold 1e-4, 'min' mode, min_lr 1e-7 per ref: src/trainer.py:108).
+
+    ``update(value)`` is called once per epoch with the validation loss and
+    returns the multiplicative ``lr_scale`` to feed into the compiled step —
+    the epoch boundary is the only host-sync point, so this costs nothing.
+    """
+
+    def __init__(
+        self,
+        base_lr: float,
+        factor: float = 0.1,
+        patience: int = 10,
+        threshold: float = 1e-4,
+        min_lr: float = 1e-7,
+    ):
+        self.base_lr = base_lr
+        self.factor = factor
+        self.patience = patience
+        self.threshold = threshold
+        self.min_lr = min_lr
+        self.best = math.inf
+        self.num_bad_epochs = 0
+        self.scale = 1.0
+
+    def update(self, value: float) -> float:
+        if value < self.best * (1.0 - self.threshold):
+            self.best = value
+            self.num_bad_epochs = 0
+        else:
+            self.num_bad_epochs += 1
+        if self.num_bad_epochs > self.patience:
+            new_lr = max(self.base_lr * self.scale * self.factor, self.min_lr)
+            self.scale = new_lr / self.base_lr
+            self.num_bad_epochs = 0
+        return self.scale

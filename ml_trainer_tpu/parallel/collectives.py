@@ -1,0 +1,56 @@
+"""Collective primitives over mesh axes.
+
+The reference's collective surface is DDP's implicit bucketed all-reduce
+plus a dead manual ``dist.all_reduce(SUM)/world`` loop
+(ref: src/trainer.py:98, 152-158).  Here the same operations are XLA
+collective primitives bound to named mesh axes — usable inside
+``shard_map``-decorated kernels (ring attention, expert dispatch) while
+ordinary data parallelism never calls them explicitly (sharding annotations
+imply them).
+"""
+
+from __future__ import annotations
+
+from typing import Union, Sequence
+
+import jax
+from jax import lax
+
+AxisName = Union[str, Sequence[str]]
+
+
+def psum(x, axis: AxisName):
+    """Sum across an axis — the ``dist.all_reduce(SUM)`` analog
+    (ref: src/trainer.py:157)."""
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: AxisName):
+    """Mean across an axis — all_reduce(SUM)/world in one op, the exact
+    semantics of the reference's ``_average_gradients``
+    (ref: src/trainer.py:152-158)."""
+    return lax.pmean(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, axis_index: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis, axis=axis_index, tiled=tiled)
+
+
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute_ring(x, axis: AxisName, shift: int = 1):
+    """Send each shard to its ring neighbour over ICI — the building block of
+    ring attention / pipelined collectives."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: AxisName):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName):
+    return lax.axis_size(axis)

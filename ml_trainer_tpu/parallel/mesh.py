@@ -1,0 +1,65 @@
+"""Device mesh construction.
+
+The mesh is the framework's world: every parallelism axis — data, fsdp,
+tensor, sequence, expert — is a named mesh dimension, and all collectives
+ride it.  This replaces the reference's flat ``world_size``/``rank``
+process-group model (ref: src/trainer.py:59-64): where DDP sees N equal
+ranks, the mesh distinguishes ICI-adjacent axes (fast, for
+tensor/sequence-parallel collectives) from DCN-spanning axes (slower,
+for data parallelism across hosts) by construction, because
+``jax.devices()`` orders devices host-major.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (DCN-friendly) to innermost (ICI-friendly).
+AXIS_ORDER = ("data", "fsdp", "expert", "sequence", "tensor")
+
+
+def mesh_shape_for(
+    n_devices: int,
+    *,
+    tensor: int = 1,
+    sequence: int = 1,
+    expert: int = 1,
+    fsdp: int = 1,
+) -> Dict[str, int]:
+    """Fill the data axis with whatever the model axes don't use."""
+    model = tensor * sequence * expert * fsdp
+    if n_devices % model:
+        raise ValueError(
+            f"{n_devices} devices not divisible by model-parallel factor {model}"
+        )
+    return {
+        "data": n_devices // model,
+        "fsdp": fsdp,
+        "expert": expert,
+        "sequence": sequence,
+        "tensor": tensor,
+    }
+
+
+def create_mesh(
+    shape: Optional[Dict[str, int]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a named mesh.  Default: 1-D ``data`` mesh over every device —
+    pure data parallelism, the reference's only strategy (SURVEY.md §2C)."""
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = {"data": len(devices)}
+    axes = [a for a in AXIS_ORDER if shape.get(a, 1) > 1] or ["data"]
+    dims = [shape.get(a, 1) for a in axes]
+    if int(np.prod(dims)) != len(devices):
+        raise ValueError(f"mesh shape {shape} does not cover {len(devices)} devices")
+    return Mesh(np.asarray(devices).reshape(dims), axis_names=tuple(axes))
+
+
+def default_mesh() -> Mesh:
+    return create_mesh()

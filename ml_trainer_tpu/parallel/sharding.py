@@ -1,0 +1,95 @@
+"""Sharding rules: PartitionSpecs for batches and parameter trees.
+
+Replaces the reference's DDP placement logic (replicate everything, shard
+only the batch via DistributedSampler; ref: src/trainer.py:60-64, 97-101)
+with explicit ``NamedSharding`` annotations:
+
+* ``batch_sharding`` — split the leading (batch) dim over the data-like
+  mesh axes; this single annotation is what turns the compiled step into a
+  data-parallel program (XLA inserts the gradient psum automatically).
+* ``shard_params`` — apply regex-keyed PartitionSpec rules to a parameter
+  pytree; this is how tensor/fsdp sharding is declared for the model zoo
+  (no analog in the reference, which has no model parallelism — SURVEY.md
+  §2C).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def _data_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
+
+
+def batch_sharding(mesh: Mesh, ndim: int = None) -> NamedSharding:
+    """Shard the leading dim over data(+fsdp) axes; replicate the rest."""
+    axes = _data_axes(mesh)
+    spec = P(axes if axes else None)
+    return NamedSharding(mesh, spec)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def logical_to_shardings(
+    tree,
+    mesh: Mesh,
+    rules: Optional[Rules] = None,
+):
+    """Pytree of NamedShardings: first regex rule matching each param path
+    wins; unmatched params are replicated (the DDP default)."""
+    compiled: List[Tuple[re.Pattern, P]] = [
+        (re.compile(pat), spec) for pat, spec in (rules or [])
+    ]
+
+    def resolve(path, leaf):
+        name = path_str(path)
+        for pat, spec in compiled:
+            if pat.search(name):
+                # Drop axes absent from this mesh so one rule set serves
+                # dp-only and dp×tp meshes alike.
+                cleaned = P(
+                    *(
+                        a
+                        if (
+                            a is None
+                            or (isinstance(a, str) and a in mesh.axis_names)
+                            or (
+                                isinstance(a, tuple)
+                                and all(x in mesh.axis_names for x in a)
+                            )
+                        )
+                        else None
+                        for a in spec
+                    )
+                )
+                return NamedSharding(mesh, cleaned)
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(resolve, tree)
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[Rules] = None):
+    """Materialize a parameter tree onto the mesh under the given rules."""
+    shardings = logical_to_shardings(params, mesh, rules)
+    return jax.tree.map(jax.device_put, params, shardings)

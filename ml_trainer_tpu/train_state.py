@@ -1,0 +1,24 @@
+"""TrainState — the complete, functional training state pytree.
+
+The reference's training state is implicit object state scattered across the
+torch model, optimizer and scheduler (ref: src/trainer.py:96-113).  On TPU
+the whole state must be a single pytree so one ``jax.jit`` step can donate
+and update it in place on-device; it also makes full checkpoint/resume (a
+reference gap, SURVEY.md §5) trivial: serialize the pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray  # global step counter (drives the LR schedule)
+    params: Any
+    opt_state: Any
+    batch_stats: Any  # {} for models without BatchNorm
+    rng: jnp.ndarray  # functional PRNG key (the torch.manual_seed analog,
+    #                   ref: src/trainer.py:47, but split per step)

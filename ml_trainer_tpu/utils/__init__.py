@@ -1,0 +1,13 @@
+from ml_trainer_tpu.utils.functions import (
+    custom_loss_function,
+    custom_pre_process_function,
+)
+from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
+
+__all__ = [
+    "custom_loss_function",
+    "custom_pre_process_function",
+    "load_history",
+    "load_model",
+    "plot_history",
+]

@@ -1,0 +1,44 @@
+"""User-extension hooks: custom preprocess + custom loss.
+
+Mirrors ref: src/utils/functions.py:5-17.  The preprocess pipeline keeps the
+reference's exact CIFAR-10 recipe and constants — RandomCrop(32, padding=4),
+RandomHorizontalFlip, scale-to-[0,1], Normalize(mean=(0.4914, 0.4822,
+0.4465), std=(0.2023, 0.1994, 0.2010)) — but as *vectorized host-side batch
+transforms* (NHWC) instead of per-sample torchvision ops, so augmentation of
+a whole batch is a handful of numpy ops and never starves the TPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ml_trainer_tpu.data.transforms import (
+    Compose,
+    Normalize,
+    RandomCrop,
+    RandomHorizontalFlip,
+    ToFloat,
+)
+
+CIFAR10_MEAN = (0.4914, 0.4822, 0.4465)
+CIFAR10_STD = (0.2023, 0.1994, 0.2010)
+
+
+def custom_pre_process_function() -> Compose:
+    """The reference augmentation pipeline (ref: src/utils/functions.py:5-12),
+    batch-vectorized.  ``ToFloat`` plays torchvision ``ToTensor``'s role
+    (uint8 [0,255] -> float32 [0,1]) but keeps NHWC layout — channels-last is
+    the natural TPU/XLA convolution layout (documented divergence)."""
+    return Compose(
+        [
+            RandomCrop(32, padding=4),
+            RandomHorizontalFlip(),
+            ToFloat(),
+            Normalize(CIFAR10_MEAN, CIFAR10_STD),
+        ]
+    )
+
+
+def custom_loss_function(output, target):
+    """Mean squared error (ref: src/utils/functions.py:15-17), pure jnp."""
+    return jnp.mean((output - target) ** 2)

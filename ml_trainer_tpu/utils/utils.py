@@ -1,0 +1,100 @@
+"""Notebook-facing utilities: history I/O, model loading, history plotting.
+
+Same public surface as ref: src/utils/utils.py:9-68 so the 01/03 notebook
+cell flow keeps working: ``load_history(dir)`` unpickles ``history.pkl``,
+``load_model(model, path)`` returns a ready-to-test model object, and
+``plot_history(history)`` renders the two-panel loss/metric curves.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from ml_trainer_tpu.checkpoint import load_model_variables, load_torch_checkpoint
+
+
+def load_history(file_dir: str) -> dict:
+    """Unpickle ``history.pkl`` from a directory (ref: src/utils/utils.py:9-12)."""
+    path = os.path.join(file_dir, "history.pkl")
+    with open(path, "rb") as fp:
+        return pickle.load(fp)
+
+
+@dataclasses.dataclass
+class LoadedModel:
+    """A module bound to restored variables — what ``load_model`` returns.
+
+    Plays the role of the weight-loaded torch module the reference hands to
+    ``trainer.test`` (ref: src/utils/utils.py:15-28, 03 nb cell-7/8); also
+    callable directly for ad-hoc inference."""
+
+    module: Any
+    variables: dict
+
+    def __call__(self, x, **kwargs):
+        return self.module.apply(self.variables, x, **kwargs)
+
+
+def load_model(model: Any, PATH: str) -> LoadedModel:
+    """Load weights from a native ``model.msgpack`` (or its directory) or a
+    reference torch ``.pth`` — the latter strips the DDP ``module.`` prefix
+    and converts layouts, preserving the reference's checkpoint
+    compatibility behaviour (ref: src/utils/utils.py:15-28)."""
+    if PATH.endswith((".pth", ".pt")):
+        params = load_torch_checkpoint(PATH)
+        variables = {"params": params}
+    else:
+        variables = load_model_variables(PATH)
+        if "params" not in variables:
+            variables = {"params": variables}
+    return LoadedModel(model, variables)
+
+
+def plot_history(history: dict) -> None:
+    """Train-vs-validation curves (ref: src/utils/utils.py:31-68): two panels
+    (loss + metric) when a metric was tracked, one otherwise; x-ticks thinned
+    past 25 epochs."""
+    from matplotlib import pyplot as plt
+
+    x = history["epochs"]
+    metric_type = history.get("metric_type")
+
+    def thin_ticks(ax):
+        if len(x) > 25:
+            ticks = np.arange(0, len(x) + 1, 5)
+            ax.set_xticks(ticks)
+            ax.set_xticklabels(ticks, rotation=45)
+        else:
+            ax.set_xticks(x)
+
+    if metric_type is not None:
+        fig, (ax_loss, ax_metric) = plt.subplots(2, 1, figsize=(10, 10))
+        for ax, train_key, val_key, ylabel, title in (
+            (ax_loss, "train_loss", "val_loss", "Loss",
+             "Training Loss vs. Validation Loss"),
+            (ax_metric, "train_metric", "val_metric", metric_type,
+             f"{metric_type} - Training vs. Validation"),
+        ):
+            ax.plot(x, history[train_key], c="C0", label="train")
+            ax.plot(x, history[val_key], c="C1", label="validation")
+            thin_ticks(ax)
+            ax.set_ylabel(ylabel)
+            ax.set_title(title)
+            ax.legend()
+        ax_loss.set_xlabel("Epochs")
+    else:
+        plt.subplots(figsize=(10, 5))
+        plt.plot(x, history["train_loss"], c="C0", label="train")
+        plt.plot(x, history["val_loss"], c="C1", label="validation")
+        plt.xticks(x, rotation=45)
+        plt.xlabel("Epochs")
+        plt.ylabel("Loss")
+        plt.title("Training Loss vs. Validation Loss")
+        plt.legend()
+    plt.tight_layout()
+    plt.show()

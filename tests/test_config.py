@@ -1,0 +1,40 @@
+"""Config system tests — whitelist enforcement and defaults parity
+(ref: src/trainer.py:26-41, 307-311)."""
+
+import pytest
+
+from ml_trainer_tpu.config import ALLOWED_KWARGS, TrainerConfig, validate_kwargs
+
+
+def test_defaults_match_reference():
+    cfg = TrainerConfig.from_kwargs()
+    assert cfg.seed == 32
+    assert cfg.scheduler is None
+    assert cfg.optimizer == "sgd"
+    assert cfg.momentum == 0.9
+    assert cfg.weight_decay == 0.0
+    assert cfg.lr == 0.001
+    assert cfg.criterion == "cross_entropy"
+    assert cfg.metric == "accuracy"
+    assert cfg.pred_function == "softmax"
+    assert cfg.model_dir == "model_output"
+
+
+def test_whitelist_is_reference_eleven_keys():
+    assert ALLOWED_KWARGS == {
+        "seed", "scheduler", "optimizer", "momentum", "weight_decay",
+        "lr", "criterion", "metric", "pred_function", "model_dir", "backend",
+    }
+
+
+def test_unknown_kwarg_raises_typeerror():
+    with pytest.raises(TypeError):
+        TrainerConfig.from_kwargs(epochs=5)
+    with pytest.raises(TypeError):
+        validate_kwargs({"nope": 1}, ALLOWED_KWARGS)
+
+
+def test_backend_aliases_map_to_tpu_native():
+    assert TrainerConfig.from_kwargs(backend="smddp").backend == "tpu"
+    assert TrainerConfig.from_kwargs(backend="nccl").backend == "tpu"
+    assert TrainerConfig.from_kwargs(backend="gloo").backend == "cpu"

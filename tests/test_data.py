@@ -1,0 +1,163 @@
+"""Data layer tests: transforms, datasets, sampler, loader, prefetch
+(the DataLoader/DistributedSampler analog, ref: src/trainer.py:60-64, 77-79;
+src/utils/functions.py:5-12)."""
+
+import jax
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.data import (
+    ArrayDataset,
+    Compose,
+    Loader,
+    Normalize,
+    RandomCrop,
+    RandomHorizontalFlip,
+    ShardedSampler,
+    SyntheticCIFAR10,
+    ToFloat,
+    prefetch_to_device,
+)
+from ml_trainer_tpu.utils.functions import (
+    CIFAR10_MEAN,
+    CIFAR10_STD,
+    custom_pre_process_function,
+)
+
+
+def test_random_crop_shape_and_determinism():
+    batch = np.arange(2 * 32 * 32 * 3, dtype=np.uint8).reshape(2, 32, 32, 3)
+    crop = RandomCrop(32, padding=4)
+    out1 = crop(batch, np.random.default_rng(0))
+    out2 = crop(batch, np.random.default_rng(0))
+    assert out1.shape == (2, 32, 32, 3)
+    np.testing.assert_array_equal(out1, out2)
+
+
+def test_random_flip_flips_some_not_all():
+    batch = np.random.default_rng(0).integers(0, 255, (64, 8, 8, 3)).astype(np.uint8)
+    out = RandomHorizontalFlip()(batch, np.random.default_rng(1))
+    flipped = (out != batch).any(axis=(1, 2, 3))
+    assert 0 < flipped.sum() < 64
+    # flipped samples are exact mirrors
+    idx = int(np.argmax(flipped))
+    np.testing.assert_array_equal(out[idx], batch[idx, :, ::-1])
+
+
+def test_normalize_constants_match_reference():
+    """Mean/std are the reference's CIFAR-10 constants
+    (ref: src/utils/functions.py:10)."""
+    assert CIFAR10_MEAN == (0.4914, 0.4822, 0.4465)
+    assert CIFAR10_STD == (0.2023, 0.1994, 0.2010)
+    pipeline = custom_pre_process_function()
+    batch = np.full((2, 32, 32, 3), 128, dtype=np.uint8)
+    out = pipeline(batch, np.random.default_rng(0))
+    assert out.dtype == np.float32
+    expected = (128 / 255.0 - np.array(CIFAR10_MEAN)) / np.array(CIFAR10_STD)
+    assert np.allclose(out[0, 16, 16], expected, atol=1e-5)
+
+
+def test_tofloat_scales_uint8():
+    batch = np.array([[[[255, 0, 128]]]], dtype=np.uint8)
+    out = ToFloat()(batch, np.random.default_rng(0))
+    assert np.allclose(out.ravel(), [1.0, 0.0, 128 / 255.0])
+
+
+def test_sharded_sampler_partitions_disjointly():
+    """DistributedSampler semantics (ref: src/trainer.py:60-61): shards are
+    disjoint, equally sized, together cover the dataset."""
+    n = 103
+    shards = [
+        ShardedSampler(n, num_replicas=4, rank=r, shuffle=True, seed=7).indices()
+        for r in range(4)
+    ]
+    sizes = {len(s) for s in shards}
+    assert sizes == {26}  # ceil(103/4)
+    all_idx = np.concatenate(shards)
+    assert len(np.unique(all_idx)) == n  # full coverage (with wrap padding)
+
+
+def test_sharded_sampler_reshuffles_per_epoch():
+    s = ShardedSampler(50, num_replicas=2, rank=0, shuffle=True, seed=0)
+    a = s.indices().copy()
+    s.set_epoch(1)
+    b = s.indices()
+    assert not np.array_equal(a, b)
+
+
+def test_loader_batching_and_len():
+    ds = ArrayDataset(np.arange(10)[:, None].astype(np.float32), np.arange(10))
+    loader = Loader(ds, batch_size=3)
+    assert len(loader) == 4
+    batches = list(loader)
+    assert [len(x) for x, _ in batches] == [3, 3, 3, 1]
+    loader_drop = Loader(ds, batch_size=3, drop_last=True)
+    assert len(loader_drop) == 3
+
+
+def test_loader_shuffle_is_epoch_deterministic():
+    ds = SyntheticCIFAR10(size=32)
+    loader = Loader(ds, batch_size=8, shuffle=True, seed=3)
+    a = [y.copy() for _, y in loader]
+    b = [y.copy() for _, y in loader]
+    np.testing.assert_array_equal(np.concatenate(a), np.concatenate(b))
+    loader.set_epoch(1)
+    c = [y.copy() for _, y in loader]
+    assert not np.array_equal(np.concatenate(a), np.concatenate(c))
+
+
+def test_loader_applies_batched_transform():
+    ds = SyntheticCIFAR10(size=16, transform=custom_pre_process_function())
+    x, y = next(iter(Loader(ds, batch_size=16)))
+    assert x.dtype == np.float32 and x.shape == (16, 32, 32, 3)
+    assert y.shape == (16,)
+
+
+def test_prefetch_to_device_yields_device_arrays():
+    ds = SyntheticCIFAR10(size=16)
+    loader = Loader(ds, batch_size=8)
+    out = list(prefetch_to_device(loader, size=2))
+    assert len(out) == 2
+    assert isinstance(out[0][0], jax.Array)
+
+
+def test_prefetch_with_mesh_sharding_splits_batch():
+    from ml_trainer_tpu.parallel import batch_sharding, create_mesh
+
+    mesh = create_mesh()  # 8 simulated devices
+    ds = SyntheticCIFAR10(size=32)
+    loader = Loader(ds, batch_size=16)
+    x, y = next(iter(prefetch_to_device(loader, sharding=batch_sharding(mesh))))
+    assert len(x.sharding.device_set) == 8
+    assert x.shape == (16, 32, 32, 3)
+
+
+def test_as_dataset_adapts_foreign_per_sample_transform():
+    """A reference-style dataset carrying a torch-style per-sample transform
+    (one argument, returns a CHW torch tensor — the torchvision ToTensor
+    shape, ref: main.py:14-18) must keep working through the batched
+    Loader."""
+    import torch
+
+    def torchvision_style(img):  # PIL Image or HWC ndarray in, CHW tensor out
+        arr = np.asarray(img, dtype=np.float32) / 255.0
+        return torch.from_numpy(arr.transpose(2, 0, 1))
+
+    class FakeTorchvisionDataset:
+        def __init__(self):
+            rng = np.random.default_rng(0)
+            self.data = rng.integers(0, 255, (8, 32, 32, 3)).astype(np.uint8)
+            self.targets = list(rng.integers(0, 10, 8))
+            self.transform = torchvision_style
+
+        def __len__(self):
+            return len(self.data)
+
+        def __getitem__(self, i):
+            return self.data[i], self.targets[i]
+
+    loader = Loader(FakeTorchvisionDataset(), batch_size=4)
+    x, y = next(iter(loader))
+    assert x.shape == (4, 32, 32, 3)  # back to NHWC float
+    assert x.dtype == np.float32
+    assert x.max() <= 1.0

@@ -1,0 +1,167 @@
+"""Unit tests for the ops registries: optimizers, schedules, losses,
+metrics, prediction functions (the reference's factory methods,
+ref: src/trainer.py:115-172)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.ops import (
+    get_criterion,
+    get_metric,
+    get_optimizer,
+    get_prediction_function,
+    get_predictions,
+    make_lr_schedule,
+    PlateauController,
+)
+
+
+# --------------------------------------------------------------- optimizers
+@pytest.mark.parametrize("name", ["sgd", "adam", "adagrad", "adamax", "adamw"])
+def test_optimizer_step_changes_params(name):
+    tx = get_optimizer(name, 0.1, momentum=0.9, weight_decay=0.01)
+    params = {"w": jnp.ones((3,))}
+    state = tx.init(params)
+    grads = {"w": jnp.full((3,), 0.5)}
+    updates, _ = tx.update(grads, state, params)
+    new = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert not np.allclose(new["w"], params["w"])
+
+
+def test_sgd_matches_torch_semantics():
+    """Coupled weight decay + momentum must follow torch.optim.SGD
+    (ref: src/trainer.py:124-126)."""
+    import torch
+
+    w0, g, lr, mom, wd = 1.5, 0.3, 0.1, 0.9, 0.05
+    tw = torch.nn.Parameter(torch.tensor([w0]))
+    topt = torch.optim.SGD([tw], lr=lr, momentum=mom, weight_decay=wd)
+    tx = get_optimizer("sgd", lr, momentum=mom, weight_decay=wd)
+    params = {"w": jnp.asarray([w0])}
+    state = tx.init(params)
+    for _ in range(3):
+        topt.zero_grad()
+        tw.grad = torch.tensor([g])
+        topt.step()
+        updates, state = tx.update({"w": jnp.asarray([g])}, state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, updates)
+    assert np.allclose(params["w"], tw.detach().numpy(), atol=1e-6)
+
+
+def test_unknown_optimizer_raises():
+    with pytest.raises(ValueError):
+        get_optimizer("lion", 0.1)
+
+
+# ---------------------------------------------------------------- schedules
+def test_constant_schedule():
+    sched = make_lr_schedule(None, 0.01, steps_per_epoch=10)
+    assert np.isclose(float(sched(0)), 0.01)
+    assert np.isclose(float(sched(999)), 0.01)
+
+
+def test_cosine_warm_restarts_matches_torch():
+    """Per-batch fractional stepping (ref: src/trainer.py:189-190) against
+    torch.optim.lr_scheduler.CosineAnnealingWarmRestarts(T_0=5, eta_min=1e-7)."""
+    import torch
+
+    base_lr, spe = 0.1, 4
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=base_lr)
+    tsched = torch.optim.lr_scheduler.CosineAnnealingWarmRestarts(
+        opt, T_0=5, eta_min=1e-7
+    )
+    sched = make_lr_schedule("CosineAnnealingWarmRestarts", base_lr, spe)
+    for epoch in range(1, 8):
+        for i in range(spe):
+            step = (epoch - 1) * spe + i
+            tsched.step(epoch - 1 + i / spe)
+            assert np.isclose(
+                float(sched(step)), opt.param_groups[0]["lr"], atol=1e-9
+            ), (epoch, i)
+
+
+def test_step_lr_decays_every_two_epochs():
+    sched = make_lr_schedule("StepLR", 1.0, steps_per_epoch=10)
+    assert np.isclose(float(sched(0)), 1.0)  # epoch 1
+    assert np.isclose(float(sched(15)), 1.0)  # epoch 2
+    assert np.isclose(float(sched(20)), 0.1)  # epoch 3
+    assert np.isclose(float(sched(45)), 0.01)  # epoch 5
+
+
+def test_plateau_controller_reduces_after_patience():
+    ctl = PlateauController(base_lr=1.0, patience=2, factor=0.1)
+    assert ctl.update(1.0) == 1.0
+    for _ in range(2):
+        ctl.update(1.0)
+    assert ctl.update(1.0) == pytest.approx(0.1)
+
+
+def test_unknown_scheduler_raises():
+    with pytest.raises(ValueError):
+        make_lr_schedule("OneCycle", 0.1, 10)
+
+
+# ------------------------------------------------------------------- losses
+def test_cross_entropy_matches_torch():
+    import torch
+
+    logits = np.random.default_rng(0).normal(size=(8, 10)).astype(np.float32)
+    targets = np.arange(8) % 10
+    ours = float(get_criterion("cross_entropy")(jnp.asarray(logits), jnp.asarray(targets)))
+    theirs = float(
+        torch.nn.CrossEntropyLoss()(torch.tensor(logits), torch.tensor(targets))
+    )
+    assert np.isclose(ours, theirs, atol=1e-6)
+
+
+def test_nll_and_l1_l2_and_custom():
+    rng = np.random.default_rng(1)
+    logp = jnp.log(jax.nn.softmax(jnp.asarray(rng.normal(size=(4, 5)), dtype=jnp.float32)))
+    y = jnp.asarray([0, 1, 2, 3])
+    nll = float(get_criterion("neg-loss")(logp, y))
+    assert nll > 0
+    a = jnp.asarray(rng.normal(size=(6,)), dtype=jnp.float32)
+    b = jnp.asarray(rng.normal(size=(6,)), dtype=jnp.float32)
+    assert np.isclose(float(get_criterion("l1")(a, b)), float(jnp.mean(jnp.abs(a - b))))
+    l2 = float(get_criterion("l2")(a, b))
+    custom = float(get_criterion("custom")(a, b))
+    assert np.isclose(l2, custom)  # custom IS mse (ref: src/utils/functions.py:15-17)
+
+
+def test_unknown_criterion_raises():
+    with pytest.raises(ValueError):
+        get_criterion("huber")
+
+
+# ------------------------------------------------------------------ metrics
+def test_accuracy_on_device():
+    outputs = jnp.asarray([[2.0, 1.0], [0.0, 3.0], [5.0, 0.0], [0.0, 1.0]])
+    targets = jnp.asarray([0, 1, 1, 1])
+    metric = get_metric("accuracy", get_prediction_function("softmax"))
+    assert float(metric(outputs, targets)) == pytest.approx(0.75)
+
+
+def test_mcrmse_matches_reference_math():
+    """Mean column-wise RMSE (ref: src/trainer.py:161-163)."""
+    rng = np.random.default_rng(2)
+    out = rng.normal(size=(16, 3)).astype(np.float32)
+    tgt = rng.normal(size=(16, 3)).astype(np.float32)
+    expected = np.mean(np.sqrt(np.mean((tgt - out) ** 2, axis=0)))
+    metric = get_metric("mcrmse")
+    assert np.isclose(float(metric(jnp.asarray(out), jnp.asarray(tgt))), expected, atol=1e-6)
+
+
+def test_metric_none_disabled():
+    assert get_metric(None) is None
+
+
+# -------------------------------------------------------------- predictions
+def test_prediction_functions():
+    x = jnp.asarray([[1.0, 3.0, 2.0]])
+    for name in ("softmax", "logsoftmax", None):
+        fn = get_prediction_function(name)
+        assert int(get_predictions(x, fn)[0]) == 1
+    assert get_prediction_function(None) is None

@@ -1,0 +1,178 @@
+"""Trainer integration tests — the 01-notebook flow as a test suite
+(SURVEY.md §4's implication: the reference's notebooks are its de-facto
+integration tests; here they are real pytest cases on a simulated mesh)."""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+from ml_trainer_tpu import Trainer, MLModel, Loader, load_history, load_model
+from ml_trainer_tpu.data import SyntheticCIFAR10
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+
+def make_datasets(n_train=64, n_val=32, transform=False):
+    t = custom_pre_process_function() if transform else None
+    return (
+        SyntheticCIFAR10(size=n_train, transform=t, seed=0),
+        SyntheticCIFAR10(size=n_val, transform=t, seed=1),
+    )
+
+
+def make_trainer(tmp_path, epochs=2, batch_size=16, **config):
+    config.setdefault("model_dir", str(tmp_path))
+    return Trainer(
+        MLModel(),
+        datasets=make_datasets(),
+        epochs=epochs,
+        batch_size=batch_size,
+        save_history=True,
+        **config,
+    )
+
+
+def test_fit_produces_history_schema(tmp_path):
+    trainer = make_trainer(tmp_path)
+    trainer.fit()
+    h = trainer.history
+    # Exact schema parity (ref: src/trainer.py:265-272).
+    assert set(h) == {
+        "epochs", "train_loss", "val_loss", "train_metric", "val_metric",
+        "metric_type",
+    }
+    assert h["epochs"] == [1, 2]
+    assert len(h["train_loss"]) == 2 and len(h["val_metric"]) == 2
+    assert h["metric_type"] == "accuracy"
+    assert all(np.isfinite(v) for v in h["train_loss"])
+
+
+def test_loss_decreases_on_learnable_data(tmp_path):
+    """Train on a trivially separable synthetic problem; loss must drop."""
+    rng = np.random.default_rng(0)
+    targets = rng.integers(0, 10, size=(256,)).astype(np.int32)
+    data = np.zeros((256, 32, 32, 3), dtype=np.float32)
+    data[np.arange(256), 0, 0, 0] = targets  # label leaked into pixel
+    from ml_trainer_tpu.data import ArrayDataset
+
+    ds = ArrayDataset(data, targets)
+    trainer = Trainer(
+        MLModel(), datasets=(ds, ds), epochs=5, batch_size=32,
+        model_dir=str(tmp_path), lr=0.01,
+    )
+    trainer.fit()
+    assert trainer.train_losses[-1] < trainer.train_losses[0]
+
+
+def test_history_pickle_roundtrip_and_model_file(tmp_path):
+    trainer = make_trainer(tmp_path)
+    trainer.fit()
+    h = load_history(str(tmp_path))
+    assert h == trainer.history
+    assert os.path.exists(os.path.join(str(tmp_path), "model.msgpack"))
+
+
+def test_load_model_and_test_flow(tmp_path):
+    """The 03-notebook flow: save → load_model → dataset-less Trainer →
+    test() (ref: 03 nb cells 5-9; src/trainer.py:277-301)."""
+    trainer = make_trainer(tmp_path)
+    trainer.fit()
+    loaded = load_model(MLModel(), str(tmp_path))
+    # Dataset-less trainer exercises the warning path (ref: src/trainer.py:66-71).
+    tester = Trainer(MLModel())
+    test_loader = Loader(SyntheticCIFAR10(size=32, seed=2), batch_size=16, shuffle=True)
+    out = tester.test(loaded, test_loader)
+    assert isinstance(out, tuple) and len(out) == 2
+    loss, acc = out
+    assert np.isfinite(loss) and 0.0 <= acc <= 1.0
+
+
+def test_metric_none_returns_loss_only(tmp_path):
+    trainer = Trainer(
+        MLModel(), datasets=make_datasets(), epochs=1, batch_size=16,
+        model_dir=str(tmp_path), metric=None,
+    )
+    trainer.fit()
+    test_loader = Loader(SyntheticCIFAR10(size=16, seed=3), batch_size=16)
+    out = trainer.test(None, test_loader)
+    assert isinstance(out, float)
+    assert trainer.train_metrics == []
+
+
+@pytest.mark.parametrize("scheduler", [
+    "CosineAnnealingWarmRestarts", "StepLR", "ReduceLROnPlateau",
+])
+def test_schedulers_run_end_to_end(tmp_path, scheduler):
+    trainer = make_trainer(tmp_path, epochs=2, scheduler=scheduler)
+    trainer.fit()
+    assert len(trainer.train_losses) == 2
+
+
+def test_optimizer_and_criterion_variants(tmp_path):
+    trainer = make_trainer(
+        tmp_path, epochs=1, optimizer="adamw", criterion="cross_entropy",
+        pred_function="logsoftmax",
+    )
+    trainer.fit()
+    assert len(trainer.train_losses) == 1
+
+
+def test_resume_from_checkpoint(tmp_path):
+    """fit(resume=True) continues from the saved epoch — the capability the
+    reference lacks (SURVEY.md §5 checkpoint/resume)."""
+    t1 = make_trainer(tmp_path, epochs=2)
+    t1.fit()
+    step_after_2 = int(t1.state.step)
+    t2 = Trainer(
+        MLModel(), datasets=make_datasets(), epochs=4, batch_size=16,
+        model_dir=str(tmp_path), save_history=True,
+    )
+    t2.fit(resume=True)
+    assert int(t2.state.step) == step_after_2 * 2
+    assert t2.history["epochs"] == [1, 2, 3, 4]
+    assert t2.history["train_loss"][:2] == pytest.approx(t1.train_losses, abs=1e-6)
+
+
+def test_seed_reproducibility(tmp_path):
+    a = make_trainer(tmp_path / "a", epochs=1, seed=5)
+    a.fit()
+    b = make_trainer(tmp_path / "b", epochs=1, seed=5)
+    b.fit()
+    assert a.train_losses == pytest.approx(b.train_losses, rel=1e-5)
+
+
+def test_unknown_config_key_raises():
+    with pytest.raises(TypeError):
+        Trainer(MLModel(), epochs=1, batch_size=8, nonsense=1)
+
+
+def test_unknown_backend_raises():
+    with pytest.raises(ValueError, match="backend"):
+        Trainer(MLModel(), epochs=1, batch_size=8, backend="mpi")
+
+
+def test_empty_loader_raises_clear_error(tmp_path):
+    """A dataset shard smaller than the per-host batch must fail loudly at
+    construction, not divide by zero after an epoch."""
+    tiny = SyntheticCIFAR10(size=4)
+    with pytest.raises(ValueError, match="no batches"):
+        Trainer(
+            MLModel(), datasets=(tiny, tiny), epochs=1, batch_size=64,
+            model_dir=str(tmp_path), is_parallel=True,
+        )
+
+
+def test_plateau_state_survives_resume(tmp_path):
+    """lr_scale and plateau bookkeeping are part of the checkpoint."""
+    t1 = make_trainer(tmp_path, epochs=2, scheduler="ReduceLROnPlateau")
+    t1._plateau.patience = 0  # force a reduction on the first bad epoch
+    t1._plateau.best = -1.0   # every epoch is "bad"
+    t1.fit()
+    assert t1._lr_scale == pytest.approx(0.01)
+    t2 = Trainer(
+        MLModel(), datasets=make_datasets(), epochs=3, batch_size=16,
+        model_dir=str(tmp_path), scheduler="ReduceLROnPlateau",
+    )
+    t2.fit(resume=True)
+    assert t2._plateau.scale <= 0.01 + 1e-9
