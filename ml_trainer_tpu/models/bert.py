@@ -1,0 +1,88 @@
+"""BERT-base encoder + sequence-classification head — the SST-2 fine-tune
+north-star config (BASELINE.json configs[2], the tokenized-dataset path).
+
+Post-LN encoder blocks (the original BERT arrangement) over token +
+position + segment embeddings; classification from the [CLS] position
+through a tanh pooler.  Padding is handled with an attention mask built
+from ``attention_mask`` input (1 = keep), threaded to ops.attention.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ml_trainer_tpu.models.layers import TransformerBlock
+from ml_trainer_tpu.models.registry import register_model
+
+
+class BertEncoder(nn.Module):
+    vocab_size: int = 30522
+    max_len: int = 512
+    type_vocab_size: int = 2
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    num_classes: Optional[int] = 2
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
+                 train: bool = False):
+        b, s = input_ids.shape
+        tok = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(
+            input_ids
+        )
+        pos_ids = jnp.arange(s)[None, :]
+        pos = nn.Embed(self.max_len, self.embed_dim, name="pos_embed")(pos_ids)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        seg = nn.Embed(self.type_vocab_size, self.embed_dim, name="seg_embed")(
+            token_type_ids
+        )
+        x = (tok + pos + seg).astype(self.dtype)
+        x = nn.LayerNorm(dtype=self.dtype, name="embed_ln")(x)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        mask = None
+        if attention_mask is not None:
+            # [B, S] (1 = real token) -> [B, 1, 1, S] broadcastable boolean.
+            mask = attention_mask[:, None, None, :].astype(bool)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dropout_rate=self.dropout_rate, post_norm=True,
+                dtype=self.dtype, attention_impl=self.attention_impl,
+                name=f"layer{i}",
+            )(x, mask=mask, train=train)
+        if self.num_classes is None:
+            return x  # sequence output (feature-extractor mode)
+        pooled = jnp.tanh(
+            nn.Dense(self.embed_dim, dtype=jnp.float32, name="pooler")(x[:, 0])
+        )
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="classifier")(
+            pooled
+        )
+
+
+@register_model("bert_base")
+def bert_base(num_classes: int = 2, **kw) -> BertEncoder:
+    """BERT-base: 12 layers, 768 wide, 12 heads (SST-2 head by default)."""
+    return BertEncoder(num_classes=num_classes, **kw)
+
+
+@register_model("bert_tiny")
+def bert_tiny(num_classes: int = 2, **kw) -> BertEncoder:
+    """Small BERT for tests: 2 layers, 128 wide."""
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("embed_dim", 128)
+    kw.setdefault("depth", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 256)
+    kw.setdefault("max_len", 128)
+    return BertEncoder(num_classes=num_classes, **kw)

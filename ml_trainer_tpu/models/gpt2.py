@@ -1,0 +1,68 @@
+"""GPT-2 124M causal LM — the pretrain north-star config
+(BASELINE.json configs[4]: grad-accum + checkpoint save/restore) and the
+framework's flagship long-context model.
+
+Pre-LN decoder stack with causal attention through ops.attention (so the
+Pallas flash kernel and ring sequence parallelism apply), learned position
+embeddings, weight-tied LM head (logits = h @ tok_embedᵀ — halves embedding
+memory and is the published GPT-2 arrangement).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ml_trainer_tpu.models.layers import TransformerBlock
+from ml_trainer_tpu.models.registry import register_model
+
+
+class GPT2(nn.Module):
+    vocab_size: int = 50257
+    max_len: int = 1024
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        b, s = input_ids.shape
+        tok_embed = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")
+        x = tok_embed(input_ids)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.01),
+            (1, self.max_len, self.embed_dim),
+        )
+        x = (x + pos[:, :s]).astype(self.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
+                causal=True, dropout_rate=self.dropout_rate, dtype=self.dtype,
+                attention_impl=self.attention_impl, name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        # Tied LM head: reuse the token embedding matrix.
+        logits = x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)
+        return logits
+
+
+@register_model("gpt2")
+def gpt2(**kw) -> GPT2:
+    """GPT-2 124M: 12 layers, 768 wide, 12 heads, 50257 vocab."""
+    return GPT2(**kw)
+
+
+@register_model("gpt2_tiny")
+def gpt2_tiny(**kw) -> GPT2:
+    """Small GPT-2 for tests: 2 layers, 128 wide, 1k vocab."""
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("embed_dim", 128)
+    kw.setdefault("depth", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_len", 256)
+    return GPT2(**kw)

@@ -1,0 +1,113 @@
+"""Shared transformer building blocks (flax), TPU-first.
+
+No analog in the reference (its only model is a 62K-param CNN,
+ref: src/model.py) — these exist for the north-star families
+(BASELINE.json configs[2..4]).  Design notes:
+
+* all attention flows through ``ops.attention`` so the Pallas flash kernel,
+  the XLA path and (via ``parallel.ring``) ring sequence-parallel attention
+  are interchangeable behind one module;
+* ``dtype`` threads bf16 activation compute through every block (params stay
+  f32 — the standard TPU mixed-precision recipe for the ViT config);
+* weight layouts keep the contraction dim leading/trailing such that the
+  tensor-parallel PartitionSpecs in ``parallel.tp_rules`` shard cleanly
+  (qkv/mlp-in column-parallel, proj/mlp-out row-parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ml_trainer_tpu.ops.attention import attention
+
+
+class MultiHeadAttention(nn.Module):
+    """Self-attention over [B, S, E] with heads split for ops.attention."""
+
+    num_heads: int
+    head_dim: Optional[int] = None
+    causal: bool = False
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        embed = x.shape[-1]
+        head_dim = self.head_dim or embed // self.num_heads
+        inner = self.num_heads * head_dim
+        # Fused QKV projection: one [E, 3·inner] matmul keeps the MXU busy
+        # and gives tensor parallelism a single column-sharded kernel.
+        qkv = nn.Dense(3 * inner, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # [B, S, inner] -> [B, H, S, D]
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        out = attention(
+            heads(q), heads(k), heads(v),
+            causal=self.causal, mask=mask,
+            implementation=self.attention_impl,
+        )
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        out = nn.Dense(embed, dtype=self.dtype, name="proj")(out)
+        if self.dropout_rate:
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class MLP(nn.Module):
+    """Transformer feed-forward block."""
+
+    hidden_dim: int
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        embed = x.shape[-1]
+        x = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc_in")(x)
+        x = self.activation(x)
+        x = nn.Dense(embed, dtype=self.dtype, name="fc_out")(x)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return x
+
+
+class TransformerBlock(nn.Module):
+    """Pre-LN transformer block (the GPT-2/ViT arrangement; BERT uses
+    post-LN via the ``post_norm`` flag)."""
+
+    num_heads: int
+    mlp_dim: int
+    causal: bool = False
+    dropout_rate: float = 0.0
+    post_norm: bool = False
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = False):
+        attn = lambda y: MultiHeadAttention(
+            self.num_heads, causal=self.causal, dropout_rate=self.dropout_rate,
+            dtype=self.dtype, attention_impl=self.attention_impl, name="attn",
+        )(y, mask=mask, train=train)
+        mlp = lambda y: MLP(
+            self.mlp_dim, dropout_rate=self.dropout_rate, dtype=self.dtype,
+            name="mlp",
+        )(y, train=train)
+        ln1 = nn.LayerNorm(dtype=self.dtype, name="ln1")
+        ln2 = nn.LayerNorm(dtype=self.dtype, name="ln2")
+        if self.post_norm:  # BERT-style
+            x = ln1(x + attn(x))
+            x = ln2(x + mlp(x))
+        else:  # GPT-2/ViT-style
+            x = x + attn(ln1(x))
+            x = x + mlp(ln2(x))
+        return x

@@ -1,0 +1,125 @@
+"""ResNet-18/50, NHWC flax — the BASELINE.json configs[0..1] models.
+
+TPU-first choices: NHWC layout throughout, 3×3/1×1 convs sized for MXU
+tiling, BatchNorm with local (per-replica) statistics — matching the
+reference's DDP behaviour, which does not synchronize BN either
+(torch DDP default; ref: src/trainer.py:98).  A ``cifar_stem`` variant
+replaces the 7×7/stride-2 + maxpool stem with a 3×3/stride-1 conv so
+ResNet-18 trains sensibly on 32×32 inputs (the local-path config).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Type
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ml_trainer_tpu.models.registry import register_model
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, name=name,
+            dtype=self.dtype,
+        )
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    padding="SAME", use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = norm("bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               dtype=self.dtype, name="downsample")(x)
+            residual = norm("bn_down")(residual)
+        return nn.relu(y + residual)
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = lambda name: nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, name=name,
+            dtype=self.dtype,
+        )
+        out_filters = self.filters * self.expansion
+        residual = x
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.relu(norm("bn1")(y))
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides),
+                    padding="SAME", use_bias=False, dtype=self.dtype,
+                    name="conv2")(y)
+        y = nn.relu(norm("bn2")(y))
+        y = nn.Conv(out_filters, (1, 1), use_bias=False, dtype=self.dtype,
+                    name="conv3")(y)
+        y = norm("bn3")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(out_filters, (1, 1),
+                               (self.strides, self.strides), use_bias=False,
+                               dtype=self.dtype, name="downsample")(x)
+            residual = norm("bn_down")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: Type[nn.Module]
+    num_classes: int = 1000
+    cifar_stem: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.cifar_stem:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False,
+                        dtype=self.dtype, name="stem_conv")(x)
+        else:
+            x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                        use_bias=False, dtype=self.dtype, name="stem_conv")(x)
+        x = nn.relu(
+            nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         dtype=self.dtype, name="stem_bn")(x)
+        )
+        if not self.cifar_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            for b in range(num_blocks):
+                strides = 2 if (stage > 0 and b == 0) else 1
+                x = self.block(
+                    filters=64 * 2 ** stage, strides=strides,
+                    dtype=self.dtype, name=f"stage{stage + 1}_block{b + 1}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+
+
+@register_model("resnet18")
+def resnet18(num_classes: int = 10, cifar_stem: bool = True,
+             dtype=jnp.float32) -> ResNet:
+    """ResNet-18 (CIFAR-10 local-path config, BASELINE.json configs[0])."""
+    return ResNet([2, 2, 2, 2], BasicBlock, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)
+
+
+@register_model("resnet50")
+def resnet50(num_classes: int = 1000, cifar_stem: bool = False,
+             dtype=jnp.float32) -> ResNet:
+    """ResNet-50 (ImageNet DP north-star config, BASELINE.json configs[1])."""
+    return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)

@@ -1,0 +1,69 @@
+"""ViT-B/16 — the bf16 mixed-precision north-star config
+(BASELINE.json configs[3]).
+
+Patchify is a single strided conv (one big MXU matmul per image), encoder is
+the shared pre-LN TransformerBlock stack, classification via the prepended
+CLS token.  ``dtype=bfloat16`` runs every activation matmul in bf16 on the
+MXU while params and the final head stay f32.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ml_trainer_tpu.models.layers import TransformerBlock
+from ml_trainer_tpu.models.registry import register_model
+
+
+class VisionTransformer(nn.Module):
+    num_classes: int = 1000
+    patch_size: int = 16
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b = x.shape[0]
+        p = self.patch_size
+        x = nn.Conv(self.embed_dim, (p, p), strides=(p, p), padding="VALID",
+                    dtype=self.dtype, name="patch_embed")(x)
+        x = x.reshape(b, -1, self.embed_dim)  # [B, num_patches, E]
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.embed_dim))
+        x = jnp.concatenate([jnp.broadcast_to(cls, (b, 1, self.embed_dim)).astype(x.dtype), x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, x.shape[1], self.embed_dim))
+        x = x + pos.astype(x.dtype)
+        if self.dropout_rate:
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        for i in range(self.depth):
+            x = TransformerBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dropout_rate=self.dropout_rate, dtype=self.dtype,
+                attention_impl=self.attention_impl, name=f"block{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
+
+
+@register_model("vit_b16")
+def vit_b16(num_classes: int = 1000, dtype=jnp.bfloat16, **kw) -> VisionTransformer:
+    """ViT-B/16: 12 layers, 768 wide, 12 heads — bf16 by default."""
+    return VisionTransformer(num_classes=num_classes, dtype=dtype, **kw)
+
+
+@register_model("vit_tiny")
+def vit_tiny(num_classes: int = 10, **kw) -> VisionTransformer:
+    """Small ViT for tests: 2 layers, 128 wide, patch 8."""
+    kw.setdefault("patch_size", 8)
+    kw.setdefault("embed_dim", 128)
+    kw.setdefault("depth", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("mlp_dim", 256)
+    return VisionTransformer(num_classes=num_classes, **kw)

@@ -1,0 +1,255 @@
+"""Attention ops: fused XLA path + Pallas TPU flash-attention kernel.
+
+The reference has no attention code at all (SURVEY.md §5 long-context:
+"entirely absent") — this module exists for the north-star model families
+(BERT/ViT/GPT-2, BASELINE.json configs[2..4]) and is designed TPU-first:
+
+* ``dot_product_attention`` — the XLA path.  Plain einsum + softmax; XLA
+  fuses the mask/scale/softmax chain and tiles the two matmuls onto the MXU.
+  Works on any backend (CPU tests run this).
+* ``flash_attention`` — a Pallas kernel computing attention with the online
+  softmax recurrence, never materializing the [S, S] score matrix in HBM:
+  the query block stays in VMEM while KV blocks stream through, carrying
+  running (max, sum, output) accumulators.  Backward currently recomputes
+  through the XLA path (a true flash backward kernel is a planned
+  refinement).
+* ``attention`` — dispatcher: 'auto' picks flash on TPU for tile-aligned
+  shapes, XLA otherwise.
+
+Shapes follow the TPU-native convention [batch, heads, seq, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _mask_bias(mask, dtype):
+    return jnp.where(mask, 0.0, jnp.finfo(dtype).min).astype(dtype)
+
+
+def dot_product_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference XLA attention.  q,k,v: [B, H, S, D] (k/v may have S_kv)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    s_q, s_k = scores.shape[-2], scores.shape[-1]
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        causal_mask = row + (s_k - s_q) >= col
+        scores = scores + _mask_bias(causal_mask, scores.dtype)
+    if mask is not None:
+        scores = scores + _mask_bias(mask, scores.dtype)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", weights.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    ).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- flash
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
+                  block_k: int, causal: bool, scale: float):
+    """One (batch·head, q-block, kv-block) grid step of the online-softmax
+    recurrence.  KV streams through VMEM one [block_k, D] tile at a time
+    (the kv grid axis iterates fastest), with running (o, m, l) accumulators
+    in VMEM scratch that persist across kv steps; the final kv step
+    normalizes and writes the output block."""
+    from jax.experimental import pallas as pl
+
+    _, block_q, d = q_ref.shape
+    kv_idx = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+    q_start = pl.program_id(1) * block_q
+    kv_start = kv_idx * block_k
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        o_scr[:] = jnp.zeros((block_q, d), jnp.float32)
+        m_scr[:] = jnp.full((block_q, 1), jnp.finfo(jnp.float32).min,
+                            jnp.float32)
+        l_scr[:] = jnp.zeros((block_q, 1), jnp.float32)
+
+    # Under causal masking, blocks fully above the diagonal contribute
+    # nothing — skip their matmuls entirely.
+    live = (q_start + block_q > kv_start) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale
+        kk = k_ref[0].astype(jnp.float32)
+        vv = v_ref[0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [block_q, block_k]
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            keep = (q_start + row) >= (kv_start + col)
+            scores = jnp.where(keep, scores, jnp.finfo(jnp.float32).min)
+        m_prev, l_prev = m_scr[:], l_scr[:]
+        m_cur = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(scores - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[:] = m_new
+        l_scr[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_scr[:] = o_scr[:] * alpha + jax.lax.dot_general(
+            p, vv, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        o_ref[0] = (o_scr[:] / l_scr[:]).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    qr = q.reshape(b * h, s_q, d)
+    kr = k.reshape(b * h, s_k, d)
+    vr = v.reshape(b * h, s_k, d)
+    kernel = functools.partial(
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+    )
+    grid = (b * h, pl.cdiv(s_q, block_q), pl.cdiv(s_k, block_k))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, s_q, d)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(
+    q, k, v,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+):
+    """Pallas flash attention, [B, H, S, D] -> [B, H, S, D].
+
+    Forward runs the tiled online-softmax kernel; the VJP recomputes through
+    ``dot_product_attention`` (O(S²) memory in backward — acceptable at the
+    current north-star sequence lengths; a flash backward kernel is the
+    planned upgrade).  ``interpret=True`` runs the kernel in interpreter
+    mode for CPU tests.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: dot_product_attention(
+            q_, k_, v_, causal=causal, scale=scale
+        ),
+        q, k, v,
+    )
+    return vjp(g)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def _flash_supported(q, k, block_q, block_k) -> bool:
+    s_q, d = q.shape[-2], q.shape[-1]
+    s_k = k.shape[-2]
+    return (
+        jax.default_backend() == "tpu"
+        and s_q == s_k  # kernel's causal mask is diagonal-aligned (see below)
+        and s_q % block_q == 0
+        and s_k % block_k == 0
+        and d % 64 == 0  # sublane-friendly head dim (Mosaic pads 64 -> 128)
+    )
+
+
+def attention(
+    q, k, v,
+    *,
+    causal: bool = False,
+    mask: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+    implementation: str = "auto",
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """Dispatch between the Pallas flash kernel and the XLA path.
+
+    ``implementation``: 'auto' | 'xla' | 'flash'.  Arbitrary masks always
+    take the XLA path (the flash kernel handles the causal mask only);
+    requesting 'flash' with a mask is an error rather than a silent drop.
+    The flash kernel also requires s_q == s_k — its causal mask is aligned
+    to the main diagonal, whereas the XLA path uses bottom-right alignment
+    for cross-length decode shapes.
+    """
+    if implementation == "flash":
+        if mask is not None:
+            raise ValueError(
+                "flash attention supports the causal mask only; pass "
+                "implementation='xla' (or 'auto') for arbitrary masks"
+            )
+        if q.shape[-2] != k.shape[-2]:
+            raise ValueError(
+                "flash attention requires equal query/key lengths "
+                f"(got {q.shape[-2]} vs {k.shape[-2]}); use the XLA path"
+            )
+        return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
+    if (
+        implementation == "auto"
+        and mask is None
+        and _flash_supported(q, k, block_q, block_k)
+    ):
+        return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
+    return dot_product_attention(q, k, v, causal=causal, mask=mask, scale=scale)

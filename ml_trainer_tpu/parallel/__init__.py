@@ -25,8 +25,18 @@ from ml_trainer_tpu.parallel.sharding import (
     logical_to_shardings,
 )
 from ml_trainer_tpu.parallel import collectives
+from ml_trainer_tpu.parallel.ring import ring_attention
+from ml_trainer_tpu.parallel.tp_rules import (
+    FSDP_RULES,
+    TRANSFORMER_TP_RULES,
+    rules_for,
+)
 
 __all__ = [
+    "ring_attention",
+    "FSDP_RULES",
+    "TRANSFORMER_TP_RULES",
+    "rules_for",
     "create_mesh",
     "default_mesh",
     "mesh_shape_for",

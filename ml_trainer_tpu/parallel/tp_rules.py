@@ -1,0 +1,57 @@
+"""Tensor/FSDP PartitionSpec rule sets for the model zoo.
+
+No analog in the reference (data parallelism is its only strategy,
+SURVEY.md §2C).  Rules are (regex over param path, PartitionSpec) pairs
+consumed by ``parallel.sharding.logical_to_shardings``: they place the big
+matmuls of the transformer blocks in the Megatron arrangement — qkv/mlp-in
+column-parallel, proj/mlp-out row-parallel — and shard embeddings over the
+vocab dim.  Under ``jax.jit`` these are *placements*, not programs: XLA
+propagates them through the step and inserts the matching all-reduces over
+the ``tensor`` axis (ICI), which is exactly how the reference's
+NCCL-all-reduce role is meant to be filled on TPU.
+
+Axes referenced here that a mesh doesn't have are dropped automatically
+(see sharding.logical_to_shardings), so one rule set serves dp-only,
+dp×tp and dp×fsdp×tp meshes.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+# Megatron-style tensor parallelism for the shared transformer blocks
+# (models/layers.py) + embeddings.  Order matters: first match wins.
+TRANSFORMER_TP_RULES = [
+    # attention: qkv column-parallel, output projection row-parallel
+    (r"attn/qkv/kernel$", P(None, "tensor")),
+    (r"attn/qkv/bias$", P("tensor")),
+    (r"attn/proj/kernel$", P("tensor", None)),
+    # mlp: in column-parallel, out row-parallel
+    (r"mlp/fc_in/kernel$", P(None, "tensor")),
+    (r"mlp/fc_in/bias$", P("tensor")),
+    (r"mlp/fc_out/kernel$", P("tensor", None)),
+    # embeddings: shard the vocab rows; position/segment tables shard their
+    # feature dim (GPT-2's pos_embed is a raw [1, L, E] param, BERT's
+    # pos/seg are nn.Embed tables — both forms covered)
+    (r"tok_embed/embedding$", P("tensor", None)),
+    (r"(pos_embed|seg_embed)/embedding$", P(None, "tensor")),
+    (r"pos_embed$", P(None, None, "tensor")),
+    # everything else (layernorms, biases, heads) replicates by default
+]
+
+# FSDP: shard every ≥2-D kernel's first dim over the fsdp axis; XLA turns
+# the placements into all-gather-on-use / reduce-scatter-on-grad.
+FSDP_RULES = [
+    (r"kernel$", P("fsdp", None)),
+    (r"embedding$", P("fsdp", None)),
+]
+
+
+def rules_for(model_name: str, strategy: str = "tp"):
+    """Pick a rule set by model family + strategy ('tp' | 'fsdp' | 'tp+fsdp')."""
+    if strategy == "fsdp":
+        return FSDP_RULES
+    rules = list(TRANSFORMER_TP_RULES)
+    if strategy == "tp+fsdp":
+        rules += FSDP_RULES
+    return rules

@@ -68,6 +68,25 @@ from ml_trainer_tpu.utils.utils import LoadedModel
 logger = get_logger("ml_trainer_tpu.trainer")
 
 
+def enable_compilation_cache(path: str = "/tmp/ml_trainer_tpu_jax_cache") -> None:
+    """Persistent XLA compilation cache, shared across processes.
+
+    The first compile of a big model costs minutes; without this every new
+    CLI invocation pays it again (torch has no analog cost — XLA does, so
+    the framework owns mitigating it).  Idempotent, best-effort.
+
+    Disabled under remote-compile PJRT tunnels (executable serialization is
+    not supported there and wedges the client)."""
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE") == "1":
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # older/newer jax without these flags: skip silently
+        pass
+
+
 def _module_takes_train(module) -> bool:
     import inspect
 
@@ -86,9 +105,17 @@ class Trainer:
         batch_size: Optional[int] = None,
         is_parallel: bool = False,
         save_history: bool = False,
+        mesh_shape: Optional[dict] = None,
+        sharding_rules=None,
         **config: Any,
     ):
+        """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
+        beyond the reference's DP-only surface (SURVEY.md §2C): e.g.
+        ``mesh_shape={'data': 4, 'tensor': 2}`` with
+        ``sharding_rules=parallel.tp_rules.TRANSFORMER_TP_RULES`` trains
+        tensor-parallel; both default to pure data parallelism."""
         logger.info("Config inputs.", config=config)
+        enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
         self.config = cfg
         # Parity attribute names (ref: src/trainer.py:30-41).
@@ -121,13 +148,28 @@ class Trainer:
         self._takes_train = _module_takes_train(model)
 
         logger.info("Loading the model.")
+        self._sharding_rules = sharding_rules
         if self.is_parallel:
             # Rendezvous — the init_process_group analog (ref: src/trainer.py:59).
             initialize_distributed(cfg.backend)
-            self.mesh = create_mesh()
+            self.mesh = create_mesh(mesh_shape)
+        elif mesh_shape is not None:
+            # An explicit mesh is honored without the multi-host rendezvous —
+            # the normal single-process multi-chip TPU VM setup.
+            self.mesh = create_mesh(mesh_shape)
         else:
             self.mesh = create_mesh(devices=jax.devices()[:1])
-        self._data_parallel = int(np.prod(self.mesh.devices.shape))
+        # Batch divides over the data-like axes only; tensor/sequence axes
+        # replicate the batch and shard the model instead.
+        self._data_parallel = int(
+            np.prod(
+                [
+                    self.mesh.shape[a]
+                    for a in ("data", "fsdp")
+                    if a in self.mesh.axis_names
+                ]
+            )
+        ) if any(a in self.mesh.axis_names for a in ("data", "fsdp")) else 1
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
 
@@ -257,16 +299,33 @@ class Trainer:
             self._plateau = PlateauController(cfg.lr)
 
         self.rng, state_rng = jax.random.split(self.rng)
-        state = TrainState(
-            step=jnp.zeros((), jnp.int32),
-            params=params,
-            opt_state=self.tx.init(params),
-            batch_stats=batch_stats,
-            rng=state_rng,
+        # Place params per the sharding rules (replicated when rules=None —
+        # the DDP initial-broadcast analog, ref: src/trainer.py:98).
+        # Optimizer state is created FROM the placed params, so momenta etc.
+        # inherit each param's sharding; leaves tx.init creates from scratch
+        # (step counters) land on the default device and are re-placed
+        # replicated so the whole state lives on the mesh.
+        from ml_trainer_tpu.parallel import shard_params
+
+        params = shard_params(params, self.mesh, self._sharding_rules)
+        if batch_stats:
+            batch_stats = shard_params(
+                batch_stats, self.mesh, self._sharding_rules
+            )
+        opt_state = jax.tree.map(
+            lambda x: x
+            if isinstance(getattr(x, "sharding", None), jax.sharding.NamedSharding)
+            else jax.device_put(x, self._replicated),
+            self.tx.init(params),
         )
-        # Replicate the full training state across the mesh — the DDP initial
-        # broadcast analog (ref: src/trainer.py:98), done once.
-        self.state = jax.device_put(state, self._replicated)
+        self.state = TrainState(
+            step=jax.device_put(jnp.zeros((), jnp.int32), self._replicated),
+            params=params,
+            opt_state=opt_state,
+            batch_stats=batch_stats,
+            rng=jax.device_put(state_rng, self._replicated),
+        )
+        self._state_shardings = jax.tree.map(lambda x: x.sharding, self.state)
         self._train_step = jax.jit(self._make_train_step(), donate_argnums=0)
         self._eval_step = self._make_eval_step(
             self.model, self._takes_train, self._has_batch_stats
@@ -507,7 +566,7 @@ class Trainer:
 
             state = multihost_utils.broadcast_one_to_all(state)
             scalars = np.asarray(multihost_utils.broadcast_one_to_all(scalars))
-        self.state = jax.device_put(state, self._replicated)
+        self.state = jax.device_put(state, self._state_shardings)
         # History lists are only written from the primary host, which has
         # them from its local checkpoint (ref: src/trainer.py:252-254).
         self.train_losses = list(saved.get("train_loss", []))

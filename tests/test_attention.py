@@ -1,0 +1,97 @@
+"""Attention numerics: XLA path invariants + Pallas flash kernel (interpret
+mode on the CPU mesh) against the reference einsum implementation."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.ops.attention import (
+    attention,
+    dot_product_attention,
+    flash_attention,
+)
+
+
+def qkv(b=2, h=4, s=128, d=64, seed=0):
+    rng = np.random.default_rng(seed)
+    shape = (b, h, s, d)
+    return tuple(
+        jnp.asarray(rng.normal(size=shape), dtype=jnp.float32) for _ in range(3)
+    )
+
+
+def test_softmax_rows_sum_to_one_effectively():
+    q, k, v = qkv(s=32)
+    ones = jnp.ones_like(v)
+    out = dot_product_attention(q, k, ones)
+    np.testing.assert_allclose(out, np.ones(out.shape), atol=1e-5)
+
+
+def test_causal_masks_future():
+    q, k, v = qkv(s=32)
+    out = dot_product_attention(q, k, v, causal=True)
+    # Perturb a future value; earlier outputs unchanged.
+    v2 = v.at[:, :, 20].add(100.0)
+    out2 = dot_product_attention(q, k, v2, causal=True)
+    np.testing.assert_allclose(out[:, :, :20], out2[:, :, :20], atol=1e-5)
+    assert not np.allclose(out[:, :, 20:], out2[:, :, 20:])
+
+
+def test_explicit_mask_matches_causal():
+    q, k, v = qkv(s=16)
+    s = 16
+    tri = jnp.tril(jnp.ones((s, s), bool))[None, None]
+    np.testing.assert_allclose(
+        dot_product_attention(q, k, v, causal=True),
+        dot_product_attention(q, k, v, mask=tri),
+        atol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference(causal):
+    q, k, v = qkv(b=1, h=2, s=256, d=64)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal, None, 128, 128, True)  # interpret
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_gradients_match_reference():
+    q, k, v = qkv(b=1, h=1, s=128, d=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, 64, 64, True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(a, b, atol=2e-3, rtol=2e-3)
+
+
+def test_dispatcher_falls_back_on_cpu():
+    q, k, v = qkv(s=64)
+    out = attention(q, k, v, implementation="auto")  # CPU -> XLA path
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_flash_explicit_request_rejects_mask_and_ragged_lengths():
+    q, k, v = qkv(s=64)
+    mask = jnp.ones((1, 1, 64, 64), bool)
+    with pytest.raises(ValueError, match="causal mask only"):
+        attention(q, k, v, mask=mask, implementation="flash")
+    q2 = q[:, :, :32]
+    with pytest.raises(ValueError, match="equal query/key"):
+        attention(q2, k, v, causal=True, implementation="flash")
+
+
+def test_flash_kv_streaming_multiple_blocks():
+    """KV now streams through the grid: multiple kv blocks per q block."""
+    q, k, v = qkv(b=1, h=1, s=256, d=64)
+    out = flash_attention(q, k, v, False, None, 64, 32, True)  # 8 kv blocks
+    ref = dot_product_attention(q, k, v)
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
