@@ -17,13 +17,13 @@ from __future__ import annotations
 
 import argparse
 import json
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ml_trainer_tpu.trainer import enable_compilation_cache
+from ml_trainer_tpu.utils.profiler import StepTimer
 
 enable_compilation_cache()
 
@@ -31,16 +31,12 @@ BASELINE_SAMPLES_PER_SEC = 966.0  # reference train throughput, BASELINE.md
 
 
 def _steady_state_rate(step, state, batches, warmup=5, iters=50):
-    """Honest samples/sec: async dispatch fenced with block_until_ready."""
-    for i in range(warmup):
+    """Steps/sec via the fenced StepTimer (compile/warmup excluded)."""
+    timer = StepTimer(warmup=warmup)
+    for i in range(warmup + iters):
         state, *_ = step(state, *batches[i % len(batches)])
-    jax.block_until_ready(state)
-    t0 = time.perf_counter()
-    for i in range(iters):
-        state, *_ = step(state, *batches[i % len(batches)])
-    jax.block_until_ready(state)
-    dt = time.perf_counter() - t0
-    return iters / dt, state
+        timer.tick(state, 1)
+    return timer.rate(), state
 
 
 def bench_parity(batch_size=32):
@@ -56,7 +52,7 @@ def bench_parity(batch_size=32):
     )
     # Pre-materialize transformed device batches so we measure the compiled
     # step (the input pipeline overlaps via prefetch during real training).
-    from ml_trainer_tpu.data import Loader, prefetch_to_device
+    from ml_trainer_tpu.data import prefetch_to_device
 
     batches = [
         (x, y, jnp.asarray(1.0, jnp.float32))

@@ -1,0 +1,239 @@
+// Native input-pipeline worker — the TPU-side replacement for torch
+// DataLoader's C-backed worker pool (SURVEY.md §2B: "DataLoader worker
+// pool ... batched, shuffled, sampler-driven host-side loading").
+//
+// A BatchWorker owns the dataset arrays (uint8 NHWC images + int32 labels,
+// zero-copy views of the caller's numpy buffers) and a team of pthreads
+// that assemble augmented batches into a bounded ring buffer ahead of the
+// consumer: index-gather, random crop with zero padding, horizontal flip,
+// uint8->float32 scale and per-channel normalize — the exact pipeline of
+// the reference's transform (ref: src/utils/functions.py:5-12) — fused
+// into one pass over the batch with no intermediate materialization.
+// Randomness is a per-batch-seeded xorshift so results are reproducible
+// regardless of thread scheduling.
+//
+// C ABI (ctypes-friendly); see ml_trainer_tpu/data/native.py for the
+// Python side.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Rng {  // xorshift64* — deterministic, cheap, per-batch seeded
+  uint64_t s;
+  explicit Rng(uint64_t seed) : s(seed ? seed : 0x9e3779b97f4a7c15ull) {}
+  uint64_t next() {
+    s ^= s >> 12;
+    s ^= s << 25;
+    s ^= s >> 27;
+    return s * 0x2545F4914F6CDD1Dull;
+  }
+  uint32_t below(uint32_t n) { return static_cast<uint32_t>(next() % n); }
+  float uniform() { return (next() >> 40) * (1.0f / (1ull << 24)); }
+};
+
+struct Batch {
+  int64_t id;
+  std::vector<float> images;    // [B, H, W, C] transformed
+  std::vector<int32_t> labels;  // [B]
+};
+
+struct Config {
+  int height, width, channels;
+  int pad;              // random-crop zero padding (0 = no crop)
+  int flip;             // 1 = random horizontal flip
+  int normalize;        // 1 = scale to [0,1] then (x - mean) / std
+  float mean[8], std_[8];
+};
+
+class BatchWorker {
+ public:
+  BatchWorker(const uint8_t* data, const int32_t* labels, int64_t n,
+              Config cfg, int batch, int threads, int queue_cap,
+              uint64_t seed)
+      : data_(data), labels_(labels), n_(n), cfg_(cfg), batch_(batch),
+        cap_(queue_cap), seed_(seed) {
+    for (int t = 0; t < threads; ++t)
+      team_.emplace_back([this] { Work(); });
+  }
+
+  ~BatchWorker() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_work_.notify_all();
+    cv_ready_.notify_all();
+    for (auto& th : team_) th.join();
+  }
+
+  // Schedule batches [0, count) of the given epoch; indices is the
+  // epoch-level permutation (length >= count * batch).
+  void StartEpoch(const int64_t* indices, int64_t count, uint64_t epoch) {
+    std::lock_guard<std::mutex> lk(mu_);
+    indices_.assign(indices, indices + count * batch_);
+    next_produce_ = 0;
+    next_consume_ = 0;
+    total_ = count;
+    epoch_salt_ = 0xa0761d6478bd642full * (epoch + 1);
+    done_.clear();
+    ++gen_;  // invalidates any in-flight batches of an abandoned epoch
+    cv_work_.notify_all();
+  }
+
+  // Blocking pop of the next in-order batch; returns batch size or -1.
+  int64_t Next(float* out_images, int32_t* out_labels) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (next_consume_ >= total_) return -1;
+    int64_t want = next_consume_;
+    cv_ready_.wait(lk, [&] { return done_.count(want) || stop_; });
+    if (stop_) return -1;
+    Batch b = std::move(done_[want]);
+    done_.erase(want);
+    ++next_consume_;
+    cv_work_.notify_all();  // consumer advanced: backpressure window moved
+    lk.unlock();
+    std::memcpy(out_images, b.images.data(), b.images.size() * sizeof(float));
+    std::memcpy(out_labels, b.labels.data(), b.labels.size() * sizeof(int32_t));
+    return static_cast<int64_t>(b.labels.size());
+  }
+
+ private:
+  void Work() {
+    std::vector<int64_t> idx;
+    for (;;) {
+      int64_t my, my_gen;
+      uint64_t my_salt;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        // Backpressure: stay at most cap_ batches ahead of the consumer.
+        cv_work_.wait(lk, [&] {
+          return stop_ || (next_produce_ < total_ &&
+                           next_produce_ < next_consume_ + cap_);
+        });
+        if (stop_) return;
+        my = next_produce_++;
+        my_gen = gen_;
+        my_salt = epoch_salt_;
+        idx.assign(indices_.begin() + my * batch_,
+                   indices_.begin() + (my + 1) * batch_);
+      }
+      Batch b = Assemble(my, idx, my_salt);
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        if (my_gen == gen_) done_[my] = std::move(b);
+      }
+      cv_ready_.notify_all();
+    }
+  }
+
+  Batch Assemble(int64_t batch_idx, const std::vector<int64_t>& idx,
+                 uint64_t epoch_salt) {
+    const int h = cfg_.height, w = cfg_.width, c = cfg_.channels;
+    const int64_t spp = static_cast<int64_t>(h) * w * c;  // samples' pixels
+    Batch b;
+    b.id = batch_idx;
+    b.images.resize(batch_ * spp);
+    b.labels.resize(batch_);
+    Rng rng(seed_ ^ epoch_salt ^ (0x51ed2701ull * (batch_idx + 1)));
+    for (int i = 0; i < batch_; ++i) {
+      const int64_t src = idx[i];
+      const uint8_t* img = data_ + src * spp;
+      b.labels[i] = labels_[src];
+      float* dst = b.images.data() + i * spp;
+      const int oy = cfg_.pad ? static_cast<int>(rng.below(2 * cfg_.pad + 1)) : 0;
+      const int ox = cfg_.pad ? static_cast<int>(rng.below(2 * cfg_.pad + 1)) : 0;
+      const bool flip = cfg_.flip && rng.uniform() < 0.5f;
+      for (int y = 0; y < h; ++y) {
+        // source row for this output row under pad-then-crop: may fall in
+        // the zero padding
+        const int sy = y + oy - cfg_.pad;
+        for (int x = 0; x < w; ++x) {
+          const int out_x = flip ? (w - 1 - x) : x;
+          const int sx = x + ox - cfg_.pad;
+          float* px = dst + (static_cast<int64_t>(y) * w + out_x) * c;
+          if (sy < 0 || sy >= h || sx < 0 || sx >= w) {
+            for (int ch = 0; ch < c; ++ch)
+              px[ch] = cfg_.normalize
+                           ? (0.0f - cfg_.mean[ch]) / cfg_.std_[ch]
+                           : 0.0f;
+          } else {
+            const uint8_t* sp = img + (static_cast<int64_t>(sy) * w + sx) * c;
+            for (int ch = 0; ch < c; ++ch) {
+              float v = sp[ch];
+              if (cfg_.normalize)
+                v = (v / 255.0f - cfg_.mean[ch]) / cfg_.std_[ch];
+              px[ch] = v;
+            }
+          }
+        }
+      }
+    }
+    return b;
+  }
+
+  const uint8_t* data_;
+  const int32_t* labels_;
+  int64_t n_;
+  Config cfg_;
+  int batch_;
+  int cap_;
+  uint64_t seed_;
+
+  std::mutex mu_;
+  std::condition_variable cv_work_, cv_ready_;
+  std::vector<std::thread> team_;
+  std::vector<int64_t> indices_;
+  int64_t next_produce_ = 0, next_consume_ = 0, total_ = 0, gen_ = 0;
+  uint64_t epoch_salt_ = 0;
+  std::map<int64_t, Batch> done_;
+  bool stop_ = false;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* batch_worker_create(const uint8_t* data, const int32_t* labels,
+                          int64_t n, int height, int width, int channels,
+                          int pad, int flip, int normalize,
+                          const float* mean, const float* std_dev,
+                          int batch, int threads, int queue_cap,
+                          uint64_t seed) {
+  Config cfg{};
+  cfg.height = height;
+  cfg.width = width;
+  cfg.channels = channels;
+  cfg.pad = pad;
+  cfg.flip = flip;
+  cfg.normalize = normalize;
+  for (int i = 0; i < channels && i < 8; ++i) {
+    cfg.mean[i] = mean ? mean[i] : 0.0f;
+    cfg.std_[i] = std_dev ? std_dev[i] : 1.0f;
+  }
+  return new BatchWorker(data, labels, n, cfg, batch, threads, queue_cap,
+                         seed);
+}
+
+void batch_worker_start_epoch(void* worker, const int64_t* indices,
+                              int64_t num_batches, uint64_t epoch) {
+  static_cast<BatchWorker*>(worker)->StartEpoch(indices, num_batches, epoch);
+}
+
+int64_t batch_worker_next(void* worker, float* out_images,
+                          int32_t* out_labels) {
+  return static_cast<BatchWorker*>(worker)->Next(out_images, out_labels);
+}
+
+void batch_worker_destroy(void* worker) {
+  delete static_cast<BatchWorker*>(worker);
+}
+
+}  // extern "C"

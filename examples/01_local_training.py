@@ -1,0 +1,74 @@
+"""Local single-device training — the 01_ML_Training_local.ipynb flow.
+
+Cell-for-cell equivalent of the reference notebook (build datasets + config
+→ Trainer(epochs=6, batch_size=32) → fit → save/load/plot history →
+load_model → test), driving the TPU instead of CPU/GPU.  Uses real CIFAR-10
+when the pickle batches are on disk, synthetic data otherwise.
+"""
+
+import os
+
+from ml_trainer_tpu import (
+    MLModel,
+    Loader,
+    Trainer,
+    load_history,
+    load_model,
+    plot_history,
+)
+from ml_trainer_tpu.data import CIFAR10, SyntheticCIFAR10
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+DATA_DIR = os.environ.get("DATA_DIR", "data")
+MODEL_DIR = os.environ.get("MODEL_DIR", "model_output")
+
+
+def build_datasets():
+    transform = custom_pre_process_function()
+    try:
+        return (
+            CIFAR10(DATA_DIR, train=True, transform=transform),
+            CIFAR10(DATA_DIR, train=False, transform=transform),
+        )
+    except FileNotFoundError:
+        print("CIFAR-10 not on disk; using synthetic data")
+        return (
+            SyntheticCIFAR10(size=2048, transform=transform),
+            SyntheticCIFAR10(size=512, transform=transform, seed=1),
+        )
+
+
+def main():
+    datasets = build_datasets()
+    # The reference notebook's config dict (01 nb cell-8).
+    config = {
+        "seed": 32,
+        "scheduler": "CosineAnnealingWarmRestarts",
+        "optimizer": "sgd",
+        "momentum": 0.9,
+        "weight_decay": 0.0,
+        "lr": 0.001,
+        "criterion": "cross_entropy",
+        "metric": "accuracy",
+        "pred_function": "softmax",
+        "model_dir": MODEL_DIR,
+    }
+    trainer = Trainer(
+        MLModel(), datasets=datasets, epochs=6, batch_size=32,
+        save_history=True, **config,
+    )
+    trainer.fit()
+
+    history = load_history(MODEL_DIR)
+    print({k: v[-1] if isinstance(v, list) else v for k, v in history.items()})
+    if os.environ.get("PLOT"):
+        plot_history(history)
+
+    loaded = load_model(MLModel(), MODEL_DIR)
+    test_loader = Loader(datasets[1], batch_size=32, shuffle=True)
+    test_loss, test_acc = trainer.test(loaded, test_loader)
+    print(f"test loss {test_loss:.4f}  accuracy {test_acc:.4f}")
+
+
+if __name__ == "__main__":
+    main()

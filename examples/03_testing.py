@@ -1,0 +1,38 @@
+"""Inference-only evaluation — the 03_ML_Testing.ipynb flow.
+
+Build a test loader → load the saved model → dataset-less Trainer (the
+"Testing only available" path, ref: src/trainer.py:66-71) →
+``trainer.test`` returning (loss, metric).  Also demonstrates loading a
+reference torch ``model.pth`` checkpoint (the ``module.``-prefix-tolerant
+import, ref: src/utils/utils.py:15-28).
+"""
+
+import os
+import sys
+
+from ml_trainer_tpu import MLModel, Loader, Trainer, load_model
+from ml_trainer_tpu.data import CIFAR10, SyntheticCIFAR10
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+MODEL_DIR = os.environ.get("MODEL_DIR", "model_output")
+DATA_DIR = os.environ.get("DATA_DIR", "data")
+
+
+def main():
+    transform = custom_pre_process_function()
+    try:
+        val_set = CIFAR10(DATA_DIR, train=False, transform=transform)
+    except FileNotFoundError:
+        val_set = SyntheticCIFAR10(size=512, transform=transform, seed=1)
+    test_loader = Loader(val_set, batch_size=32, shuffle=True)
+
+    checkpoint = sys.argv[1] if len(sys.argv) > 1 else MODEL_DIR
+    model = load_model(MLModel(), checkpoint)  # .msgpack dir or torch .pth
+
+    trainer = Trainer(MLModel())  # no datasets: inference-only trainer
+    test_loss, test_metric = trainer.test(model, test_loader)
+    print(f"loss {test_loss:.4f}  accuracy {test_metric:.4f}")
+
+
+if __name__ == "__main__":
+    main()

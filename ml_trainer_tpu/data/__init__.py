@@ -13,6 +13,12 @@ from ml_trainer_tpu.data.datasets import (
 )
 from ml_trainer_tpu.data.loader import Loader, prefetch_to_device
 from ml_trainer_tpu.data.sampler import ShardedSampler
+from ml_trainer_tpu.data.text import (
+    PackedLMDataset,
+    TokenizedDataset,
+    load_sst2_tsv,
+    tokenize_texts,
+)
 from ml_trainer_tpu.data.transforms import (
     Compose,
     Normalize,
@@ -31,6 +37,10 @@ __all__ = [
     "Loader",
     "prefetch_to_device",
     "ShardedSampler",
+    "PackedLMDataset",
+    "TokenizedDataset",
+    "load_sst2_tsv",
+    "tokenize_texts",
     "Compose",
     "Normalize",
     "RandomCrop",

@@ -1,0 +1,182 @@
+"""ctypes bindings for the native batch worker (csrc/batch_worker.cpp).
+
+``NativeLoader`` is a drop-in alternative to the Python ``Loader`` for
+uint8-image array datasets: batch assembly (gather + crop + flip +
+normalize) runs in C++ threads that stay ``queue_cap`` batches ahead of the
+training loop — the torch DataLoader worker-pool role (SURVEY.md §2B)
+without worker processes or pickling.  The shared library is built with g++
+on first use if missing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.data.datasets import ArrayDataset
+from ml_trainer_tpu.data.sampler import ShardedSampler
+
+_CSRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+_LIB_PATH = os.path.abspath(os.path.join(_CSRC, "libbatch_worker.so"))
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library() -> str:
+    src = os.path.join(_CSRC, "batch_worker.cpp")
+    subprocess.run(
+        ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-Wall", "-shared",
+         "-o", _LIB_PATH, src],
+        check=True,
+        capture_output=True,
+    )
+    return _LIB_PATH
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            _build_library()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.batch_worker_create.restype = ctypes.c_void_p
+        lib.batch_worker_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ]
+        lib.batch_worker_start_epoch.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_uint64,
+        ]
+        lib.batch_worker_next.restype = ctypes.c_int64
+        lib.batch_worker_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        lib.batch_worker_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+class NativeLoader:
+    """C++-threaded Loader for uint8 NHWC image datasets.
+
+    Mirrors the Python ``Loader`` iteration contract (len, set_epoch,
+    yields (images, labels) numpy batches) with the reference's CIFAR-10
+    augmentation fused into the native pass (crop pad 4 / flip / normalize,
+    ref: src/utils/functions.py:5-12).
+    """
+
+    def __init__(
+        self,
+        dataset: ArrayDataset,
+        batch_size: int,
+        shuffle: bool = True,
+        sampler: Optional[ShardedSampler] = None,
+        pad: int = 4,
+        flip: bool = True,
+        normalize: Optional[Tuple[Tuple[float, ...], Tuple[float, ...]]] = None,
+        num_threads: int = 4,
+        queue_cap: int = 8,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        if dataset.data.dtype != np.uint8 or dataset.data.ndim != 4:
+            raise ValueError("NativeLoader requires uint8 NHWC image data")
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self._sampler = sampler
+        self.seed = seed
+        self.drop_last = drop_last
+        self._epoch = 0
+        self._data = np.ascontiguousarray(dataset.data)
+        self._labels = np.ascontiguousarray(dataset.targets.astype(np.int32))
+        _, h, w, c = self._data.shape
+        self._shape = (h, w, c)
+        if normalize is None:
+            from ml_trainer_tpu.utils.functions import CIFAR10_MEAN, CIFAR10_STD
+
+            normalize = (CIFAR10_MEAN, CIFAR10_STD)
+        mean = (ctypes.c_float * c)(*normalize[0][:c])
+        std = (ctypes.c_float * c)(*normalize[1][:c])
+        lib = load_library()
+        self._lib = lib
+        self._handle = lib.batch_worker_create(
+            self._data.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(ctypes.c_void_p),
+            len(dataset), h, w, c, pad, int(flip), 1, mean, std,
+            self.batch_size, num_threads, queue_cap, seed + 1,
+        )
+
+    @property
+    def sampler(self):
+        from ml_trainer_tpu.data.loader import _TrivialSampler
+
+        return self._sampler if self._sampler is not None else _TrivialSampler(
+            len(self.dataset)
+        )
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+        if self._sampler is not None:
+            self._sampler.set_epoch(epoch)
+
+    def __len__(self) -> int:
+        n = len(self.sampler)
+        return n // self.batch_size if self.drop_last else -(-n // self.batch_size)
+
+    def _indices(self) -> np.ndarray:
+        if self._sampler is not None:
+            return np.asarray(self._sampler.indices(), np.int64)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self._epoch))
+            return rng.permutation(len(self.dataset)).astype(np.int64)
+        return np.arange(len(self.dataset), dtype=np.int64)
+
+    def __iter__(self):
+        n_batches = len(self)
+        idx = np.ascontiguousarray(
+            self._indices()[: n_batches * self.batch_size], np.int64
+        )
+        self._lib.batch_worker_start_epoch(
+            self._handle,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n_batches,
+            self._epoch,
+        )
+        h, w, c = self._shape
+        for _ in range(n_batches):
+            images = np.empty((self.batch_size, h, w, c), np.float32)
+            labels = np.empty((self.batch_size,), np.int32)
+            got = self._lib.batch_worker_next(
+                self._handle,
+                images.ctypes.data_as(ctypes.c_void_p),
+                labels.ctypes.data_as(ctypes.c_void_p),
+            )
+            if got < 0:
+                return
+            yield images, labels
+
+    def __del__(self):
+        handle = getattr(self, "_handle", None)
+        if handle:
+            self._lib.batch_worker_destroy(handle)
+            self._handle = None

@@ -1,0 +1,138 @@
+"""Tokenized-text datasets — the BERT/GPT-2 north-star data path
+(BASELINE.json configs[2], [4]: "tokenized src/dataloader.py path").
+
+The reference has no text pipeline at all; this module provides:
+
+* ``TokenizedDataset`` — padded [N, S] token ids (+ labels), an ArrayDataset
+  so the Loader's fast batched-gather path applies;
+* ``tokenize_texts`` — HuggingFace tokenizer wrapper (transformers is an
+  optional dependency; a deterministic hash tokenizer stands in when the
+  pretrained vocab files aren't on disk, keeping the path testable in
+  zero-egress environments);
+* ``load_sst2_tsv`` — the GLUE SST-2 on-disk format (sentence\\tlabel).
+* ``PackedLMDataset`` — concatenate-and-chunk token stream for causal-LM
+  pretraining (every token supervised, no padding waste).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.data.datasets import ArrayDataset
+
+
+def _stable_hash(word: str) -> int:
+    """Process-independent word hash (builtin ``hash`` is salted per
+    interpreter — it would tokenize the same text differently on every
+    host/run)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.md5(word.encode("utf-8")).digest()[:8], "little"
+    )
+
+
+def _hash_tokenize(text: str, vocab_size: int) -> List[int]:
+    """Deterministic fallback tokenizer (whitespace + stable hash)."""
+    return [
+        (_stable_hash(w) % (vocab_size - 3)) + 3  # reserve 0=pad, 1=cls, 2=sep
+        for w in text.lower().split()
+    ]
+
+
+def tokenize_texts(
+    texts: Sequence[str],
+    max_len: int = 128,
+    tokenizer_name: Optional[str] = None,
+    vocab_size: int = 30522,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Texts -> (input_ids [N, max_len], attention_mask [N, max_len]).
+
+    Uses ``transformers.AutoTokenizer`` when ``tokenizer_name`` is given and
+    loadable (local files honored; no download attempted in offline envs),
+    otherwise the hash fallback with BERT-style [CLS] ... [SEP] framing.
+    """
+    if tokenizer_name is not None:
+        try:
+            from transformers import AutoTokenizer
+
+            tok = AutoTokenizer.from_pretrained(
+                tokenizer_name, local_files_only=True
+            )
+            enc = tok(
+                list(texts), max_length=max_len, padding="max_length",
+                truncation=True, return_tensors="np",
+            )
+            return (
+                enc["input_ids"].astype(np.int32),
+                enc["attention_mask"].astype(np.int32),
+            )
+        except Exception:
+            pass  # fall through to the offline tokenizer
+    ids = np.zeros((len(texts), max_len), np.int32)
+    mask = np.zeros((len(texts), max_len), np.int32)
+    for i, text in enumerate(texts):
+        toks = [1] + _hash_tokenize(text, vocab_size)[: max_len - 2] + [2]
+        ids[i, : len(toks)] = toks
+        mask[i, : len(toks)] = 1
+    return ids, mask
+
+
+class TokenizedDataset(ArrayDataset):
+    """[N, S] token ids with integer labels (sequence classification) —
+    feeds BERT fine-tuning through the ordinary Loader."""
+
+    def __init__(self, input_ids: np.ndarray, labels: np.ndarray,
+                 attention_mask: Optional[np.ndarray] = None):
+        super().__init__(np.asarray(input_ids, np.int32),
+                         np.asarray(labels, np.int32))
+        self.attention_mask = (
+            None if attention_mask is None
+            else np.asarray(attention_mask, np.int32)
+        )
+
+    @classmethod
+    def from_texts(cls, texts: Sequence[str], labels: Sequence[int],
+                   max_len: int = 128, tokenizer_name: Optional[str] = None,
+                   vocab_size: int = 30522):
+        """``vocab_size`` bounds the offline tokenizer's ids — it MUST match
+        the model's embedding table (out-of-range ids gather garbage)."""
+        ids, mask = tokenize_texts(texts, max_len, tokenizer_name, vocab_size)
+        return cls(ids, np.asarray(labels), mask)
+
+
+def load_sst2_tsv(path: str, max_len: int = 128,
+                  tokenizer_name: Optional[str] = None,
+                  vocab_size: int = 30522) -> TokenizedDataset:
+    """GLUE SST-2 ``train.tsv``/``dev.tsv`` (header, sentence\\tlabel)."""
+    texts, labels = [], []
+    with open(path) as fp:
+        header = fp.readline()
+        for line in fp:
+            sentence, _, label = line.rstrip("\n").rpartition("\t")
+            if sentence:
+                texts.append(sentence)
+                labels.append(int(label))
+    return TokenizedDataset.from_texts(
+        texts, labels, max_len, tokenizer_name, vocab_size
+    )
+
+
+class PackedLMDataset(ArrayDataset):
+    """Concatenated token stream chunked into [N, seq_len] blocks with
+    next-token targets — the GPT-2 pretraining layout."""
+
+    def __init__(self, token_stream: np.ndarray, seq_len: int = 1024):
+        stream = np.asarray(token_stream, np.int32).ravel()
+        n = (len(stream) - 1) // seq_len
+        if n < 1:
+            raise ValueError(
+                f"token stream of {len(stream)} tokens too short for "
+                f"seq_len={seq_len}"
+            )
+        data = stream[: n * seq_len].reshape(n, seq_len)
+        targets = stream[1 : n * seq_len + 1].reshape(n, seq_len)
+        super().__init__(data, targets)

@@ -28,6 +28,7 @@ class BertEncoder(nn.Module):
     mlp_dim: int = 3072
     dropout_rate: float = 0.0
     num_classes: Optional[int] = 2
+    pad_token_id: int = 0
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
 
@@ -35,6 +36,10 @@ class BertEncoder(nn.Module):
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
                  train: bool = False):
         b, s = input_ids.shape
+        if attention_mask is None:
+            # Derive padding mask from the pad token so the plain (ids,
+            # labels) Loader path masks correctly without a side channel.
+            attention_mask = (input_ids != self.pad_token_id).astype(jnp.int32)
         tok = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(
             input_ids
         )

@@ -245,6 +245,12 @@ def attention(
                 "flash attention requires equal query/key lengths "
                 f"(got {q.shape[-2]} vs {k.shape[-2]}); use the XLA path"
             )
+        if q.shape[-2] % block_q or k.shape[-2] % block_k:
+            raise ValueError(
+                f"flash attention requires sequence lengths divisible by the "
+                f"block sizes (S={q.shape[-2]}, block_q={block_q}, "
+                f"block_k={block_k}); pad the sequence or use the XLA path"
+            )
         return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
     if (
         implementation == "auto"

@@ -25,6 +25,7 @@ from ml_trainer_tpu.parallel.sharding import (
     logical_to_shardings,
 )
 from ml_trainer_tpu.parallel import collectives
+from ml_trainer_tpu.parallel.desync import check_desync, param_fingerprint
 from ml_trainer_tpu.parallel.ring import ring_attention
 from ml_trainer_tpu.parallel.tp_rules import (
     FSDP_RULES,
@@ -33,6 +34,8 @@ from ml_trainer_tpu.parallel.tp_rules import (
 )
 
 __all__ = [
+    "check_desync",
+    "param_fingerprint",
     "ring_attention",
     "FSDP_RULES",
     "TRANSFORMER_TP_RULES",

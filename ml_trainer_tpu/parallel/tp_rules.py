@@ -30,20 +30,24 @@ TRANSFORMER_TP_RULES = [
     (r"mlp/fc_in/kernel$", P(None, "tensor")),
     (r"mlp/fc_in/bias$", P("tensor")),
     (r"mlp/fc_out/kernel$", P("tensor", None)),
-    # embeddings: shard the vocab rows; position/segment tables shard their
-    # feature dim (GPT-2's pos_embed is a raw [1, L, E] param, BERT's
-    # pos/seg are nn.Embed tables — both forms covered)
-    (r"tok_embed/embedding$", P("tensor", None)),
-    (r"(pos_embed|seg_embed)/embedding$", P(None, "tensor")),
+    # embeddings: shard the FEATURE dim.  Vocab-dim (Megatron-row) sharding
+    # would need the vocab padded to a multiple of the tensor degree —
+    # GPT-2's 50257 is not — so the embed dim (a multiple of the head count)
+    # is the always-divisible choice; the tied LM head then reduces over the
+    # sharded feature dim with one psum.  (GPT-2's pos_embed is a raw
+    # [1, L, E] param, BERT's pos/seg are nn.Embed tables — both covered.)
+    (r"(tok_embed|pos_embed|seg_embed)/embedding$", P(None, "tensor")),
     (r"pos_embed$", P(None, None, "tensor")),
     # everything else (layernorms, biases, heads) replicates by default
 ]
 
 # FSDP: shard every ≥2-D kernel's first dim over the fsdp axis; XLA turns
 # the placements into all-gather-on-use / reduce-scatter-on-grad.
+# Embedding tables shard the feature dim (vocab sizes like GPT-2's 50257
+# rarely divide the axis; the feature dim always does).
 FSDP_RULES = [
     (r"kernel$", P("fsdp", None)),
-    (r"embedding$", P("fsdp", None)),
+    (r"embedding$", P(None, "fsdp")),
 ]
 
 

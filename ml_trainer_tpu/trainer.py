@@ -107,13 +107,20 @@ class Trainer:
         save_history: bool = False,
         mesh_shape: Optional[dict] = None,
         sharding_rules=None,
+        grad_accum_steps: int = 1,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
         beyond the reference's DP-only surface (SURVEY.md §2C): e.g.
         ``mesh_shape={'data': 4, 'tensor': 2}`` with
         ``sharding_rules=parallel.tp_rules.TRANSFORMER_TP_RULES`` trains
-        tensor-parallel; both default to pure data parallelism."""
+        tensor-parallel; both default to pure data parallelism.
+
+        ``grad_accum_steps`` splits each global batch into that many
+        microbatches inside the compiled step (a ``lax.scan`` over gradient
+        accumulation, one optimizer update per batch) — the GPT-2 north-star
+        requirement (BASELINE.json configs[4]); effective batch semantics
+        and the LR schedule's step count are unchanged."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -149,6 +156,9 @@ class Trainer:
 
         logger.info("Loading the model.")
         self._sharding_rules = sharding_rules
+        if grad_accum_steps < 1:
+            raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
+        self.grad_accum_steps = int(grad_accum_steps)
         if self.is_parallel:
             # Rendezvous — the init_process_group analog (ref: src/trainer.py:59).
             initialize_distributed(cfg.backend)
@@ -198,11 +208,12 @@ class Trainer:
     def _build_loaders(self, train_set, val_set, batch_size, cfg) -> None:
         logger.info("Loading training and validation set.")
         logger.info("Preparing the data.")
-        d = self._data_parallel
+        d = self._data_parallel * self.grad_accum_steps
         # Reference semantics: global batch ÷ world, floored at 1
         # (ref: src/trainer.py:63-64).  Here the division happens through the
         # mesh sharding, so we only round the global batch down to a multiple
-        # of the data-parallel degree (and up to at least one per chip).
+        # of the data-parallel degree × grad-accum microbatch count (and up
+        # to at least one sample per chip per microbatch).
         eff = max(batch_size // d, 1) * d
         if eff != batch_size:
             logger.warning(
@@ -334,14 +345,13 @@ class Trainer:
     def _make_train_step(self):
         criterion, metric_fn, tx = self.criterion, self.metric_fn, self.tx
         has_bs, model_apply = self._has_batch_stats, self._apply
+        accum = self.grad_accum_steps
 
-        def train_step(state: TrainState, x, y, lr_scale):
-            rng, dropout_rng = jax.random.split(state.rng)
-
+        def grads_for(params, batch_stats, x, y, dropout_rng):
             def loss_fn(params):
                 variables = {"params": params}
                 if has_bs:
-                    variables["batch_stats"] = state.batch_stats
+                    variables["batch_stats"] = batch_stats
                     out, mutated = model_apply(
                         variables, x, train=True,
                         rngs={"dropout": dropout_rng}, mutable=True,
@@ -351,22 +361,56 @@ class Trainer:
                     out = model_apply(
                         variables, x, train=True, rngs={"dropout": dropout_rng}
                     )
-                    new_bs = state.batch_stats
+                    new_bs = batch_stats
                 return criterion(out, y), (out, new_bs)
 
             (loss, (out, new_bs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
-            )(state.params)
-            # Data-parallel gradient averaging happens HERE, implicitly: the
-            # batch is sharded over the mesh's data axis while params are
-            # replicated, so XLA inserts the psum the reference performs via
-            # DDP's bucketed all-reduce (ref: src/trainer.py:98, 152-158).
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            updates = jax.tree.map(lambda u: u * lr_scale, updates)
-            new_params = optax.apply_updates(state.params, updates)
+            )(params)
             metric_val = (
                 metric_fn(out, y) if metric_fn is not None else jnp.zeros(())
             )
+            return grads, new_bs, loss, metric_val
+
+        def train_step(state: TrainState, x, y, lr_scale):
+            rng, dropout_rng = jax.random.split(state.rng)
+            # Data-parallel gradient averaging happens implicitly in
+            # grads_for: the batch is sharded over the mesh's data axis while
+            # params are replicated, so XLA inserts the psum the reference
+            # performs via DDP's bucketed all-reduce
+            # (ref: src/trainer.py:98, 152-158).
+            if accum == 1:
+                grads, new_bs, loss, metric_val = grads_for(
+                    state.params, state.batch_stats, x, y, dropout_rng
+                )
+            else:
+                # lax.scan over microbatches: gradients sum on-device, one
+                # optimizer update per global batch (GPT-2 grad-accum
+                # config, BASELINE.json configs[4]).
+                micro = x.shape[0] // accum
+                xm = x.reshape((accum, micro) + x.shape[1:])
+                ym = y.reshape((accum, micro) + y.shape[1:])
+
+                def body(carry, xy):
+                    bs, g_sum, l_sum, m_sum, drng = carry
+                    drng, sub = jax.random.split(drng)
+                    g, bs, l, m = grads_for(state.params, bs, *xy, sub)
+                    g_sum = jax.tree.map(jnp.add, g_sum, g)
+                    return (bs, g_sum, l_sum + l, m_sum + m, drng), None
+
+                zeros = jax.tree.map(jnp.zeros_like, state.params)
+                (new_bs, g_sum, l_sum, m_sum, _), _ = jax.lax.scan(
+                    body,
+                    (state.batch_stats, zeros, jnp.zeros(()), jnp.zeros(()),
+                     dropout_rng),
+                    (xm, ym),
+                )
+                grads = jax.tree.map(lambda g: g / accum, g_sum)
+                loss = l_sum / accum
+                metric_val = m_sum / accum
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            updates = jax.tree.map(lambda u: u * lr_scale, updates)
+            new_params = optax.apply_updates(state.params, updates)
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
@@ -473,6 +517,12 @@ class Trainer:
             self.clear()
             if self._plateau is not None:
                 self._lr_scale = self._plateau.update(self.val_losses[-1])
+            if process_count() > 1:
+                # Cross-host replica-desync check (the "race detector",
+                # SURVEY.md §5) — one scalar over DCN per epoch.
+                from ml_trainer_tpu.parallel.desync import check_desync
+
+                check_desync(self.state.params)
             # Save on the primary host only (ref: src/trainer.py:252-254).
             if is_primary():
                 self.save_model(self.model_dir)

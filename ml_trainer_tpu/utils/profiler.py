@@ -1,0 +1,70 @@
+"""Tracing / profiling utilities.
+
+The reference's only observability is tqdm postfix text and wall-clock in
+committed notebook output (SURVEY.md §5 tracing).  TPU-native replacements:
+
+* ``trace(logdir)`` — context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace (XLA op timeline, HBM usage) for any code
+  region, e.g. ``with trace('/tmp/tb'): trainer.fit()``.
+* ``annotate(name)`` — named region that shows up inside the trace.
+* ``StepTimer`` — honest steady-state step timing: async dispatch means
+  naive wall-clocks lie (SURVEY.md §7 hard part (e)), so the timer fences
+  with ``block_until_ready`` only at measurement boundaries.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Any, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, create_perfetto_link: bool = False):
+    jax.profiler.start_trace(logdir, create_perfetto_link=create_perfetto_link)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named trace region (``jax.profiler.TraceAnnotation``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Steady-state samples/sec with warmup exclusion and sync fencing.
+
+    Usage::
+
+        timer = StepTimer(warmup=5)
+        for batch in loader:
+            state, loss, _ = step(state, *batch)
+            timer.tick(state, batch_size)
+        print(timer.rate())   # samples/sec, compile excluded
+    """
+
+    def __init__(self, warmup: int = 5):
+        self.warmup = warmup
+        self._seen = 0
+        self._samples = 0
+        self._t0: Optional[float] = None
+        self._fence: Any = None
+
+    def tick(self, fence: Any, n_samples: int) -> None:
+        self._seen += 1
+        self._fence = fence
+        if self._seen == self.warmup:
+            jax.block_until_ready(fence)
+            self._t0 = time.perf_counter()
+        elif self._seen > self.warmup:
+            self._samples += n_samples
+
+    def rate(self) -> Optional[float]:
+        if self._t0 is None or self._samples == 0:
+            return None
+        jax.block_until_ready(self._fence)
+        return self._samples / (time.perf_counter() - self._t0)
