@@ -123,5 +123,10 @@ def parse_args(argv=None):
     return parser.parse_args(argv)
 
 
+def cli(argv=None) -> None:
+    """Console-script entry point (``ml-trainer-tpu`` after install)."""
+    main(parse_args(argv))
+
+
 if __name__ == "__main__":
-    main(parse_args())
+    cli()

@@ -7,6 +7,7 @@ from ml_trainer_tpu.checkpoint.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
     save_model_variables,
+    wait_for_checkpoints,
 )
 from ml_trainer_tpu.checkpoint.torch_import import load_torch_checkpoint
 
@@ -19,5 +20,6 @@ __all__ = [
     "restore_checkpoint",
     "save_checkpoint",
     "save_model_variables",
+    "wait_for_checkpoints",
     "load_torch_checkpoint",
 ]

@@ -12,23 +12,45 @@ cannot resume.  Here both layers exist:
   preempted TPU job resumes exactly — the deliberate extension called out in
   SURVEY.md §5.
 
-Writes are atomic (tmp + rename) and host-0-only at the call sites, matching
-the reference's rank-0 gate (ref: src/trainer.py:252-254).
+Checkpoint format (v2): a ``checkpoint_<epoch>/`` DIRECTORY holding one
+``.npy`` file per state leaf plus a JSON manifest — per-leaf, streamed
+writes that scale to GPT-2-class states (the v1 monolithic pickle
+double-buffered ~1.5GB in RAM and executed arbitrary bytes on load;
+``.npy`` restores with ``allow_pickle=False``).  Writes are atomic
+(tmp dir + rename), host-0-only at the call sites matching the reference's
+rank-0 gate (ref: src/trainer.py:252-254), and optionally asynchronous:
+``save_checkpoint(..., block=False)`` snapshots device→host synchronously
+(the compiled step donates state buffers, so references alone would go
+stale) and hands the disk writes to a single background writer thread so
+the training loop isn't stalled by I/O.  Legacy v1 ``.pkl`` checkpoints
+remain readable.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import re
-from typing import Any, Optional, Tuple
+import shutil
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, List, Optional, Tuple
 
 import jax
+import numpy as np
 from flax import serialization
 
 MODEL_FILE = "model.msgpack"
 CHECKPOINT_PREFIX = "checkpoint_"
-_CKPT_RE = re.compile(rf"^{CHECKPOINT_PREFIX}(\d+)\.pkl$")
+MANIFEST = "manifest.json"
+_CKPT_RE = re.compile(rf"^{CHECKPOINT_PREFIX}(\d+)(\.pkl)?$")
+
+# One writer thread: checkpoint writes are ordered (epoch N lands before
+# N+1) and never overlap, while the training loop keeps running.
+_writer = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt-writer")
+_pending: List[Future] = []
+_pending_lock = threading.Lock()
 
 
 def _atomic_write(path: str, data: bytes) -> None:
@@ -54,27 +76,104 @@ def load_model_variables(path: str) -> Any:
         return serialization.msgpack_restore(fp.read())
 
 
+# ----------------------------------------------------------- v2 leaf format
+def _flatten(tree: Any, path=()):
+    """(path tuple, leaf) pairs over the nested state dict, sorted keys.
+    Empty dicts (optax EmptyState, empty batch_stats) are themselves leaves —
+    dropping them would change the state-dict structure on restore."""
+    if isinstance(tree, dict) and tree:
+        for key in sorted(tree):
+            yield from _flatten(tree[key], path + (str(key),))
+    else:
+        yield path, tree
+
+
+def _unflatten(pairs) -> Any:
+    root: dict = {}
+    for path, leaf in pairs:
+        node = root
+        for key in path[:-1]:
+            node = node.setdefault(key, {})
+        node[path[-1]] = leaf
+    return root
+
+
+def _write_checkpoint_dir(
+    final_dir: str, state_dict: Any, history: dict, epoch: int
+) -> None:
+    tmp_dir = final_dir + ".tmp"
+    if os.path.isdir(tmp_dir):
+        shutil.rmtree(tmp_dir)
+    os.makedirs(tmp_dir)
+    leaves = []
+    for i, (path, leaf) in enumerate(_flatten(state_dict)):
+        if isinstance(leaf, dict):  # empty container leaf
+            leaves.append({"path": list(path), "empty": True})
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        fname = f"leaf_{i:05d}.npy"
+        np.save(os.path.join(tmp_dir, fname), arr, allow_pickle=False)
+        leaves.append({"path": list(path), "file": fname})
+    manifest = {
+        "format": 2,
+        "epoch": epoch,
+        "history": history,
+        "leaves": leaves,
+    }
+    with open(os.path.join(tmp_dir, MANIFEST), "w") as fp:
+        json.dump(manifest, fp)
+    if os.path.isdir(final_dir):
+        shutil.rmtree(final_dir)
+    os.replace(tmp_dir, final_dir)
+
+
+def wait_for_checkpoints() -> None:
+    """Join all in-flight async checkpoint writes, re-raising any failure."""
+    with _pending_lock:
+        pending, _pending[:] = list(_pending), []
+    for fut in pending:
+        fut.result()
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: Any,
     history: dict,
     epoch: int,
     keep: int = 3,
+    block: bool = True,
 ) -> str:
+    """Write ``checkpoint_<epoch>/``.  With ``block=False`` the device→host
+    snapshot happens synchronously (the training step may DONATE the state
+    buffers, so the device arrays can be invalid by the next step) and only
+    the disk writes run on the background writer thread; call
+    ``wait_for_checkpoints()`` (the trainer does at fit-end) to surface
+    errors."""
+    import copy
+
     os.makedirs(ckpt_dir, exist_ok=True)
-    payload = {
-        "state": serialization.to_state_dict(jax.device_get(state)),
-        "history": history,
-        "epoch": epoch,
-    }
-    path = os.path.join(ckpt_dir, f"{CHECKPOINT_PREFIX}{epoch}.pkl")
-    _atomic_write(path, pickle.dumps(payload))
-    prune_checkpoints(ckpt_dir, keep)
+    state_dict = jax.device_get(serialization.to_state_dict(state))
+    # Deep-copy on the caller's thread: the trainer hands us its LIVE
+    # history lists, which the next epoch mutates while the writer runs.
+    history = copy.deepcopy(history)
+    path = os.path.join(ckpt_dir, f"{CHECKPOINT_PREFIX}{epoch}")
+
+    def job():
+        _write_checkpoint_dir(path, state_dict, history, epoch)
+        prune_checkpoints(ckpt_dir, keep)
+
+    if block:
+        job()
+    else:
+        fut = _writer.submit(job)
+        with _pending_lock:
+            _pending.append(fut)
     return path
 
 
 def _scan_checkpoints(ckpt_dir: str):
-    """Sorted (epoch, filename) pairs of checkpoints in a directory."""
+    """Sorted (epoch, filename) pairs of checkpoints (v2 dirs + v1 pkls).
+    In-flight ``.tmp`` dirs are skipped."""
     if not os.path.isdir(ckpt_dir):
         return []
     found = []
@@ -89,7 +188,11 @@ def prune_checkpoints(ckpt_dir: str, keep: int) -> None:
     if not keep:
         return
     for _, name in _scan_checkpoints(ckpt_dir)[:-keep]:
-        os.remove(os.path.join(ckpt_dir, name))
+        full = os.path.join(ckpt_dir, name)
+        if os.path.isdir(full):
+            shutil.rmtree(full)
+        else:
+            os.remove(full)
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
@@ -102,6 +205,23 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:
     """Restore (state, history, epoch); the template supplies pytree
     structure (the trainer always has one before restoring)."""
+    if os.path.isdir(path):
+        with open(os.path.join(path, MANIFEST)) as fp:
+            manifest = json.load(fp)
+        pairs = [
+            (
+                tuple(leaf["path"]),
+                {}
+                if leaf.get("empty")
+                else np.load(
+                    os.path.join(path, leaf["file"]), allow_pickle=False
+                ),
+            )
+            for leaf in manifest["leaves"]
+        ]
+        state = serialization.from_state_dict(state_template, _unflatten(pairs))
+        return state, manifest["history"], manifest["epoch"]
+    # Legacy v1 monolithic pickle (round-1 checkpoints).
     with open(path, "rb") as fp:
         payload = pickle.load(fp)
     state = serialization.from_state_dict(state_template, payload["state"])

@@ -115,6 +115,13 @@ def prefetch_to_device(
     queue = collections.deque()
     multi_host = jax.process_count() > 1
 
+    def fit_rank(a, s):
+        if s is None:
+            return s
+        from ml_trainer_tpu.parallel.sharding import fit_sharding_to_rank
+
+        return fit_sharding_to_rank(s, np.ndim(a))
+
     def put(batch):
         if sharding is None:
             return jax.tree.map(jax.device_put, batch)
@@ -125,11 +132,13 @@ def prefetch_to_device(
             # ref: src/trainer.py:60-64).
             return jax.tree.map(
                 lambda a: jax.make_array_from_process_local_data(
-                    sharding, np.asarray(a)
+                    fit_rank(a, sharding), np.asarray(a)
                 ),
                 batch,
             )
-        return jax.tree.map(lambda a: jax.device_put(a, sharding), batch)
+        return jax.tree.map(
+            lambda a: jax.device_put(a, fit_rank(a, sharding)), batch
+        )
 
     it = iter(iterator)
     for batch in itertools.islice(it, size):

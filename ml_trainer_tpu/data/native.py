@@ -75,6 +75,54 @@ def native_available() -> bool:
         return False
 
 
+def native_plan(dataset) -> Optional[dict]:
+    """NativeLoader kwargs if this dataset can run through the fused C++
+    pipeline with IDENTICAL semantics to the Python Loader + its transform:
+    uint8 NHWC array data whose transform is the reference augmentation
+    (RandomCrop(p=4)? + RandomHorizontalFlip? + ToFloat + Normalize,
+    ref: src/utils/functions.py:5-12).  Returns None when the Python path
+    must be used (foreign/no transform, float data, non-default flip p,
+    crop size != image size)."""
+    from ml_trainer_tpu.data.transforms import (
+        Compose,
+        Normalize,
+        RandomCrop,
+        RandomHorizontalFlip,
+        ToFloat,
+    )
+
+    data = getattr(dataset, "data", None)
+    if not (
+        isinstance(data, np.ndarray)
+        and data.dtype == np.uint8
+        and data.ndim == 4
+    ):
+        return None
+    t = getattr(dataset, "transform", None)
+    if t is None:
+        return None
+    ts = list(t.transforms) if isinstance(t, Compose) else [t]
+    i, pad, flip = 0, 0, False
+    if i < len(ts) and isinstance(ts[i], RandomCrop):
+        if ts[i].size != data.shape[1] or data.shape[1] != data.shape[2]:
+            return None
+        pad, i = ts[i].padding, i + 1
+    if i < len(ts) and isinstance(ts[i], RandomHorizontalFlip):
+        if ts[i].p != 0.5:
+            return None
+        flip, i = True, i + 1
+    if not (i < len(ts) and isinstance(ts[i], ToFloat)):
+        return None
+    i += 1
+    if not (i < len(ts) and isinstance(ts[i], Normalize)):
+        return None
+    normalize = (tuple(ts[i].mean.tolist()), tuple(ts[i].std.tolist()))
+    i += 1
+    if i != len(ts):
+        return None
+    return dict(pad=pad, flip=flip, normalize=normalize)
+
+
 class NativeLoader:
     """C++-threaded Loader for uint8 NHWC image datasets.
 
@@ -153,9 +201,17 @@ class NativeLoader:
 
     def __iter__(self):
         n_batches = len(self)
-        idx = np.ascontiguousarray(
-            self._indices()[: n_batches * self.batch_size], np.int64
-        )
+        need = n_batches * self.batch_size
+        idx = self._indices().astype(np.int64, copy=False)
+        if idx.size < need:
+            # drop_last=False with a ragged tail: the C++ side
+            # unconditionally copies n_batches*batch_size indices
+            # (csrc/batch_worker.cpp start_epoch), so pad by wrapping —
+            # same convention as ShardedSampler — rather than hand it a
+            # short buffer (out-of-bounds read).  The final batch then
+            # repeats leading samples instead of being short.
+            idx = np.resize(idx, need)
+        idx = np.ascontiguousarray(idx[:need], np.int64)
         self._lib.batch_worker_start_epoch(
             self._handle,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),

@@ -26,6 +26,8 @@ class GPT2(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
+    mesh: object = None  # jax Mesh; needed for attention_impl='ring'
+    moe_experts: int = 0  # >0: MoE feed-forward in every block (EP axis)
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
@@ -43,7 +45,8 @@ class GPT2(nn.Module):
             x = TransformerBlock(
                 num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
                 causal=True, dropout_rate=self.dropout_rate, dtype=self.dtype,
-                attention_impl=self.attention_impl, name=f"block{i}",
+                attention_impl=self.attention_impl, mesh=self.mesh,
+                moe_experts=self.moe_experts, name=f"block{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
         # Tied LM head: reuse the token embedding matrix.
@@ -66,3 +69,11 @@ def gpt2_tiny(**kw) -> GPT2:
     kw.setdefault("num_heads", 4)
     kw.setdefault("max_len", 256)
     return GPT2(**kw)
+
+
+@register_model("gpt2_moe_tiny")
+def gpt2_moe_tiny(**kw) -> GPT2:
+    """gpt2_tiny with a 4-expert MoE feed-forward — the expert-parallel
+    test/demo config (mesh axis ``expert``, rules_for(..., 'ep'))."""
+    kw.setdefault("moe_experts", 4)
+    return gpt2_tiny(**kw)

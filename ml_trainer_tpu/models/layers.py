@@ -33,6 +33,7 @@ class MultiHeadAttention(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
+    mesh: Optional[object] = None  # jax Mesh, required for 'ring'
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
@@ -52,6 +53,7 @@ class MultiHeadAttention(nn.Module):
             heads(q), heads(k), heads(v),
             causal=self.causal, mask=mask,
             implementation=self.attention_impl,
+            mesh=self.mesh,
         )
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
@@ -91,17 +93,27 @@ class TransformerBlock(nn.Module):
     post_norm: bool = False
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
+    mesh: Optional[object] = None
+    moe_experts: int = 0  # >0: MoE feed-forward (expert parallelism)
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False):
         attn = lambda y: MultiHeadAttention(
             self.num_heads, causal=self.causal, dropout_rate=self.dropout_rate,
-            dtype=self.dtype, attention_impl=self.attention_impl, name="attn",
+            dtype=self.dtype, attention_impl=self.attention_impl,
+            mesh=self.mesh, name="attn",
         )(y, mask=mask, train=train)
-        mlp = lambda y: MLP(
-            self.mlp_dim, dropout_rate=self.dropout_rate, dtype=self.dtype,
-            name="mlp",
-        )(y, train=train)
+        if self.moe_experts:
+            from ml_trainer_tpu.models.moe import MoEMLP
+
+            mlp = lambda y: MoEMLP(
+                self.moe_experts, self.mlp_dim, dtype=self.dtype, name="mlp",
+            )(y, train=train)
+        else:
+            mlp = lambda y: MLP(
+                self.mlp_dim, dropout_rate=self.dropout_rate, dtype=self.dtype,
+                name="mlp",
+            )(y, train=train)
         ln1 = nn.LayerNorm(dtype=self.dtype, name="ln1")
         ln2 = nn.LayerNorm(dtype=self.dtype, name="ln2")
         if self.post_norm:  # BERT-style

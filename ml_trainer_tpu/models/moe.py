@@ -1,0 +1,95 @@
+"""Mixture-of-Experts feed-forward — expert parallelism over the ``expert``
+mesh axis.
+
+The reference has no MoE (SURVEY.md §2C: expert parallel "not required");
+this fills the reserved ``expert`` axis with the TPU-idiomatic GShard/
+Mesh-TensorFlow formulation: experts live as ONE stacked parameter
+[E, ...] sharded ``P('expert', ...)``, and routing is dense einsum algebra
+over a capacity-bounded one-hot dispatch tensor — no gather/scatter, no
+data-dependent shapes, so XLA lowers the whole layer onto the MXU and turns
+the expert-axis shardings into the dispatch all-to-alls.
+
+Top-1 routing (Switch-Transformer style) with capacity factor + auxiliary
+load-balance loss (reported via ``self.sow`` so trainers can add it).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Drop-in replacement for the dense transformer MLP block.
+
+    x: [B, S, M] -> [B, S, M]; E experts each an (M -> hidden -> M) MLP.
+    Tokens route to their top-1 expert, bounded by
+    ``capacity = ceil(capacity_factor * tokens / E)`` per expert; overflow
+    tokens fall through the residual (output 0 for the MLP branch).
+    """
+
+    num_experts: int
+    hidden_dim: int
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+    activation: Callable = nn.gelu
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, s, m = x.shape
+        e = self.num_experts
+        tokens = b * s
+        capacity = max(int(self.capacity_factor * tokens / e), 1)
+        xt = x.reshape(tokens, m)
+
+        # Router (always f32 — small matmul, numerics matter).
+        router = nn.Dense(e, dtype=jnp.float32, name="router")
+        probs = jax.nn.softmax(router(xt.astype(jnp.float32)), axis=-1)
+
+        expert_idx = jnp.argmax(probs, axis=-1)                # [T]
+        expert_mask = jax.nn.one_hot(expert_idx, e)            # [T, E]
+        gate = jnp.sum(probs * expert_mask, axis=-1)           # [T]
+
+        # Switch-Transformer load-balance loss: E * sum(fraction * prob).
+        fraction = jnp.mean(expert_mask, axis=0)
+        prob_mean = jnp.mean(probs, axis=0)
+        self.sow(
+            "losses", "moe_aux_loss",
+            e * jnp.sum(fraction * prob_mean),
+        )
+
+        # Position of each token within its expert's capacity buffer;
+        # tokens past capacity are dropped (residual passes them through).
+        position = jnp.cumsum(expert_mask, axis=0) * expert_mask - 1.0
+        keep = (position < capacity) & (expert_mask > 0)        # [T, E]
+        onehot_pos = jax.nn.one_hot(
+            jnp.clip(position, 0, capacity - 1).astype(jnp.int32), capacity
+        )                                                       # [T, E, C]
+        dispatch = onehot_pos * keep[..., None]                 # [T, E, C]
+        combine = dispatch * gate[:, None, None]                # [T, E, C]
+
+        # Stacked expert weights, sharded over the expert mesh axis by the
+        # EP_RULES PartitionSpecs (parallel/tp_rules.py).
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, m, self.hidden_dim),
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, self.hidden_dim, m),
+        )
+
+        xin = jnp.einsum(
+            "tec,tm->ecm", dispatch.astype(self.dtype), xt.astype(self.dtype)
+        )                                                       # [E, C, M]
+        h = self.activation(
+            jnp.einsum("ecm,emh->ech", xin, wi.astype(self.dtype))
+        )
+        xout = jnp.einsum("ech,ehm->ecm", h, wo.astype(self.dtype))
+        out = jnp.einsum(
+            "tec,ecm->tm", combine.astype(self.dtype), xout
+        )
+        return out.reshape(b, s, m).astype(x.dtype)

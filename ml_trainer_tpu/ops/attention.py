@@ -10,9 +10,9 @@ The reference has no attention code at all (SURVEY.md §5 long-context:
 * ``flash_attention`` — a Pallas kernel computing attention with the online
   softmax recurrence, never materializing the [S, S] score matrix in HBM:
   the query block stays in VMEM while KV blocks stream through, carrying
-  running (max, sum, output) accumulators.  Backward currently recomputes
-  through the XLA path (a true flash backward kernel is a planned
-  refinement).
+  running (max, sum, output) accumulators.  Backward is the matching
+  FlashAttention-2-style block-recompute kernel pair (dQ / dK+dV) driven by
+  the saved per-row logsumexp, so memory is O(S) in both directions.
 * ``attention`` — dispatcher: 'auto' picks flash on TPU for tile-aligned
   shapes, XLA otherwise.
 
@@ -63,7 +63,7 @@ def dot_product_attention(
 
 
 # --------------------------------------------------------------------- flash
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr, *,
                   block_k: int, causal: bool, scale: float):
     """One (batch·head, q-block, kv-block) grid step of the online-softmax
     recurrence.  KV streams through VMEM one [block_k, D] tile at a time
@@ -118,6 +118,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr, *,
     @pl.when(kv_idx == num_kv - 1)
     def _finalize():
         o_ref[0] = (o_scr[:] / l_scr[:]).astype(o_ref.dtype)
+        # Per-row logsumexp of the scaled scores — the only softmax
+        # statistic the flash backward needs (FlashAttention-2 style).
+        lse_ref[0] = (m_scr[:] + jnp.log(l_scr[:]))[:, 0]
 
 
 def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
@@ -133,7 +136,7 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
         _flash_kernel, block_k=block_k, causal=causal, scale=scale
     )
     grid = (b * h, pl.cdiv(s_q, block_q), pl.cdiv(s_k, block_k))
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -144,9 +147,16 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q), lambda i, j, kv: (i, j),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
@@ -154,7 +164,185 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qr, kr, vr)
-    return out.reshape(b, h, s_q, d)
+    return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, block_k: int, causal: bool,
+                         scale: float):
+    """dQ pass: one q-block stays resident while KV blocks stream through
+    (kv is the fastest grid axis); dQ accumulates in VMEM scratch and is
+    written once on the last kv step.  Recomputes P from (q, k, lse) — the
+    block-recompute that keeps backward memory O(S)."""
+    from jax.experimental import pallas as pl
+
+    _, block_q, d = q_ref.shape
+    kv_idx = pl.program_id(2)
+    num_kv = pl.num_programs(2)
+    q_start = pl.program_id(1) * block_q
+    kv_start = kv_idx * block_k
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros((block_q, d), jnp.float32)
+
+    live = (q_start + block_q > kv_start) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        vv = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]          # [block_q, 1]
+        delta = delta_ref[0][:, None]      # [block_q, 1]
+        scores = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(scores - lse)          # [block_q, block_k]
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where((q_start + row) >= (kv_start + col), p, 0.0)
+        dp = jax.lax.dot_general(
+            do, vv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_scr[:] = dq_scr[:] + jax.lax.dot_general(
+            ds, kk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
+                          causal: bool, scale: float):
+    """dK/dV pass: one kv-block stays resident while Q blocks stream through
+    (q is the fastest grid axis); dK and dV accumulate in VMEM scratch."""
+    from jax.experimental import pallas as pl
+
+    _, block_k, d = k_ref.shape
+    q_idx = pl.program_id(2)
+    num_q = pl.num_programs(2)
+    kv_start = pl.program_id(1) * block_k
+    q_start = q_idx * block_q
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros((block_k, d), jnp.float32)
+        dv_scr[:] = jnp.zeros((block_k, d), jnp.float32)
+
+    live = (q_start + block_q > kv_start) if causal else True
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)
+        kk = k_ref[0].astype(jnp.float32)
+        vv = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        scores = jax.lax.dot_general(
+            q, kk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(scores - lse)          # [block_q, block_k]
+        if causal:
+            row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+            col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
+            p = jnp.where((q_start + row) >= (kv_start + col), p, 0.0)
+        dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vv, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk_scr[:] = dk_scr[:] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(q_idx == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
+                    interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, s_q, d = q.shape
+    s_k = k.shape[2]
+    qr = q.reshape(b * h, s_q, d)
+    kr = k.reshape(b * h, s_k, d)
+    vr = v.reshape(b * h, s_k, d)
+    dor = g.reshape(b * h, s_q, d)
+    lser = lse.reshape(b * h, s_q)
+    # delta_i = rowsum(dO_i * O_i) — a cheap elementwise reduce; let XLA
+    # fuse it rather than adding a third kernel pass.
+    delta = jnp.sum(
+        dor.astype(jnp.float32) * out.reshape(b * h, s_q, d).astype(jnp.float32),
+        axis=-1,
+    )
+    nq, nkv = pl.cdiv(s_q, block_q), pl.cdiv(s_k, block_k)
+
+    qspec = pl.BlockSpec((1, block_q, d), lambda i, j, x: (i, j, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec_stream = pl.BlockSpec((1, block_k, d), lambda i, j, x: (i, x, 0),
+                                 memory_space=pltpu.VMEM)
+    rowspec = pl.BlockSpec((1, block_q), lambda i, j, x: (i, j),
+                           memory_space=pltpu.VMEM)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
+                          causal=causal, scale=scale),
+        grid=(b * h, nq, nkv),
+        in_specs=[qspec, kvspec_stream, kvspec_stream, qspec, rowspec,
+                  rowspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+
+    kvspec = pl.BlockSpec((1, block_k, d), lambda i, j, x: (i, j, 0),
+                          memory_space=pltpu.VMEM)
+    qspec_stream = pl.BlockSpec((1, block_q, d), lambda i, j, x: (i, x, 0),
+                                memory_space=pltpu.VMEM)
+    rowspec_stream = pl.BlockSpec((1, block_q), lambda i, j, x: (i, x),
+                                  memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
+                          causal=causal, scale=scale),
+        grid=(b * h, nkv, nq),
+        in_specs=[qspec_stream, kvspec, kvspec, qspec_stream, rowspec_stream,
+                  rowspec_stream],
+        out_specs=[kvspec, kvspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_k, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr, dor, lser, delta)
+    return (
+        dq.reshape(b, h, s_q, d),
+        dk.reshape(b, h, s_k, d),
+        dv.reshape(b, h, s_k, d),
+    )
 
 
 @functools.partial(
@@ -170,34 +358,40 @@ def flash_attention(
 ):
     """Pallas flash attention, [B, H, S, D] -> [B, H, S, D].
 
-    Forward runs the tiled online-softmax kernel; the VJP recomputes through
-    ``dot_product_attention`` (O(S²) memory in backward — acceptable at the
-    current north-star sequence lengths; a flash backward kernel is the
-    planned upgrade).  ``interpret=True`` runs the kernel in interpreter
-    mode for CPU tests.
+    Forward runs the tiled online-softmax kernel and saves only the per-row
+    logsumexp; the VJP is the FlashAttention-2-style block-recompute pair of
+    Pallas kernels (dQ streaming KV, dK/dV streaming Q), so training memory
+    stays O(S) — the [S, S] score matrix is never materialized in either
+    direction.  ``interpret=True`` runs the kernels in interpreter mode for
+    CPU tests.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_forward(
+    out, _ = _flash_forward(
         q, k, v, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
+    return out
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
-    return out, (q, k, v)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(
+        q, k, v, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+    )
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: dot_product_attention(
-            q_, k_, v_, causal=causal, scale=scale
-        ),
-        q, k, v,
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_backward(
+        q, k, v, out, lse, g, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return vjp(g)
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -224,16 +418,39 @@ def attention(
     implementation: str = "auto",
     block_q: int = 128,
     block_k: int = 128,
+    mesh=None,
+    ring_axis: str = "sequence",
 ):
-    """Dispatch between the Pallas flash kernel and the XLA path.
+    """Dispatch between the Pallas flash kernel, ring sequence parallelism
+    and the XLA path.
 
-    ``implementation``: 'auto' | 'xla' | 'flash'.  Arbitrary masks always
-    take the XLA path (the flash kernel handles the causal mask only);
-    requesting 'flash' with a mask is an error rather than a silent drop.
-    The flash kernel also requires s_q == s_k — its causal mask is aligned
-    to the main diagonal, whereas the XLA path uses bottom-right alignment
-    for cross-length decode shapes.
+    ``implementation``: 'auto' | 'xla' | 'flash' | 'ring'.  Arbitrary masks
+    always take the XLA path (the flash kernel handles the causal mask
+    only); requesting 'flash' with a mask is an error rather than a silent
+    drop.  The flash kernel also requires s_q == s_k — its causal mask is
+    aligned to the main diagonal, whereas the XLA path uses bottom-right
+    alignment for cross-length decode shapes.
+
+    'ring' runs sequence-parallel ring attention (parallel.ring) over
+    ``mesh[ring_axis]`` — K/V shards rotate around the ICI ring while each
+    device attends its local query shard; requires ``mesh``.
     """
+    if implementation == "ring":
+        if mask is not None:
+            raise ValueError(
+                "ring attention supports the causal mask only; pass "
+                "implementation='xla' for arbitrary masks"
+            )
+        if mesh is None or ring_axis not in mesh.axis_names:
+            raise ValueError(
+                "implementation='ring' needs a mesh with a live "
+                f"'{ring_axis}' axis (got mesh={mesh})"
+            )
+        from ml_trainer_tpu.parallel.ring import ring_attention
+
+        return ring_attention(
+            q, k, v, mesh, axis_name=ring_axis, causal=causal, scale=scale
+        )
     if implementation == "flash":
         if mask is not None:
             raise ValueError(

@@ -20,12 +20,14 @@ from ml_trainer_tpu.parallel.distributed import (
 )
 from ml_trainer_tpu.parallel.sharding import (
     batch_sharding,
+    fit_sharding_to_rank,
     replicated,
     shard_params,
     logical_to_shardings,
 )
 from ml_trainer_tpu.parallel import collectives
 from ml_trainer_tpu.parallel.desync import check_desync, param_fingerprint
+from ml_trainer_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from ml_trainer_tpu.parallel.ring import ring_attention
 from ml_trainer_tpu.parallel.tp_rules import (
     FSDP_RULES,
@@ -36,6 +38,8 @@ from ml_trainer_tpu.parallel.tp_rules import (
 __all__ = [
     "check_desync",
     "param_fingerprint",
+    "pipeline_apply",
+    "stack_stage_params",
     "ring_attention",
     "FSDP_RULES",
     "TRANSFORMER_TP_RULES",
@@ -47,6 +51,7 @@ __all__ = [
     "process_count",
     "process_index",
     "batch_sharding",
+    "fit_sharding_to_rank",
     "replicated",
     "shard_params",
     "logical_to_shardings",

@@ -19,7 +19,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 # Canonical axis order: outermost (DCN-friendly) to innermost (ICI-friendly).
-AXIS_ORDER = ("data", "fsdp", "expert", "sequence", "tensor")
+AXIS_ORDER = ("data", "fsdp", "stage", "expert", "sequence", "tensor")
 
 
 def mesh_shape_for(
@@ -29,9 +29,10 @@ def mesh_shape_for(
     sequence: int = 1,
     expert: int = 1,
     fsdp: int = 1,
+    stage: int = 1,
 ) -> Dict[str, int]:
     """Fill the data axis with whatever the model axes don't use."""
-    model = tensor * sequence * expert * fsdp
+    model = tensor * sequence * expert * fsdp * stage
     if n_devices % model:
         raise ValueError(
             f"{n_devices} devices not divisible by model-parallel factor {model}"
@@ -39,6 +40,7 @@ def mesh_shape_for(
     return {
         "data": n_devices // model,
         "fsdp": fsdp,
+        "stage": stage,
         "expert": expert,
         "sequence": sequence,
         "tensor": tensor,

@@ -1,0 +1,130 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over a mesh axis.
+
+The reference has no pipeline parallelism (SURVEY.md §2C: "not required for
+parity"); this fills the reserved ``stage`` mesh axis with a real,
+TPU-idiomatic implementation: every device holds ONE stage's parameters
+(stacked pytree sharded over ``stage``), activations hop stage→stage over
+ICI via ``lax.ppermute``, and the whole schedule is a single ``lax.scan``
+over clock ticks inside ``shard_map`` — one compiled program, no host-side
+stage loop, reverse-differentiable (scan + ppermute both are).
+
+Schedule: with S stages and M microbatches the scan runs S+M-1 ticks; at
+tick t stage s computes microbatch t-s (devices idle in the ramp-up/down
+triangles, the standard GPipe bubble of (S-1)/(S+M-1)).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+
+def stack_stage_params(per_stage_params: list) -> Any:
+    """Stack a list of per-stage parameter pytrees (identical structure)
+    into one pytree with a leading stage dim — the layout that shards over
+    the ``stage`` mesh axis with ``P('stage', ...)``."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def _pipeline_local(params, x, *, stage_fn, axis_name, n_micro):
+    """Per-device body under shard_map.
+
+    params: this device's stage params (leading stage dim of size 1).
+    x: the full [n_micro, mb, ...] microbatched input (replicated).
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda p: p[0], params)  # drop the stage dim
+    mb_shape = x.shape[1:]
+    fwd_perm = [(s, s + 1) for s in range(n_stages - 1)]
+
+    def tick(carry, t):
+        prev_out, outputs = carry
+        # Activations computed last tick hop to the next stage.
+        recv = lax.ppermute(prev_out, axis_name, fwd_perm)
+        # Stage 0 injects microbatch t (zeros past the ramp); others consume
+        # the hop.  Indexing is clamped — masked ticks compute garbage that
+        # is never written anywhere.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        my_in = jnp.where(
+            stage == 0,
+            lax.dynamic_index_in_dim(x, mb_idx, keepdims=False),
+            recv,
+        )
+        out = stage_fn(params, my_in)
+        # The last stage finishes microbatch t-(S-1) at tick t.
+        done_idx = t - (n_stages - 1)
+        is_done = jnp.logical_and(stage == n_stages - 1, done_idx >= 0)
+        outputs = lax.cond(
+            is_done,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, out, jnp.clip(done_idx, 0, n_micro - 1), 0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        return (out, outputs), None
+
+    init = (
+        jnp.zeros(mb_shape, x.dtype),
+        jnp.zeros((n_micro,) + mb_shape, x.dtype),
+    )
+    (_, outputs), _ = lax.scan(
+        tick, init, jnp.arange(n_micro + n_stages - 1)
+    )
+    # Only the last stage holds real outputs; psum broadcasts them (every
+    # other stage contributes zeros), matching the replicated out_spec.
+    outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    return lax.psum(outputs, axis_name)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    mesh: Mesh,
+    *,
+    axis_name: str = "stage",
+    n_microbatches: int = None,
+) -> jax.Array:
+    """Run ``x`` through ``n_stages`` sequential stages, pipelined.
+
+    ``stage_fn(params_for_one_stage, microbatch) -> microbatch_out`` must
+    preserve the activation shape (classic equal-width pipeline).
+    ``stage_params``: pytree whose leaves have leading dim n_stages
+    (see ``stack_stage_params``).  ``x``: [batch, ...] — split into
+    ``n_microbatches`` equal microbatches (default: one per stage).
+    Semantically equivalent to folding ``stage_fn`` serially; the pipeline
+    only changes WHERE each stage runs and WHEN.
+    """
+    n_stages = mesh.shape[axis_name]
+    n_micro = n_microbatches or n_stages
+    batch = x.shape[0]
+    if batch % n_micro:
+        raise ValueError(
+            f"batch {batch} not divisible into {n_micro} microbatches"
+        )
+    xm = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
+    fn = shard_map(
+        functools.partial(
+            _pipeline_local,
+            stage_fn=stage_fn,
+            axis_name=axis_name,
+            n_micro=n_micro,
+        ),
+        mesh=mesh,
+        in_specs=(
+            jax.tree.map(lambda _: P(axis_name), stage_params),
+            P(),
+        ),
+        out_specs=P(),
+        check_vma=False,
+    )
+    out = fn(stage_params, xm)
+    return out.reshape((batch,) + out.shape[2:])

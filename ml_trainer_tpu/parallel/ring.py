@@ -92,12 +92,19 @@ def ring_attention(
     axis_name: str = "sequence",
     causal: bool = False,
     scale: Optional[float] = None,
+    batch_axis: Optional[str] = "data",
 ) -> jax.Array:
     """Sequence-parallel attention over [B, H, S, D] arrays whose S dim is
-    (or will be) sharded over ``mesh[axis_name]``."""
+    (or will be) sharded over ``mesh[axis_name]``.
+
+    ``batch_axis`` names the mesh axis the batch dim is sharded over (so the
+    ring composes with data parallelism without an implicit all-gather);
+    axes absent from the mesh are ignored."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    spec = P(None, None, axis_name, None)
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        batch_axis = None
+    spec = P(batch_axis, None, axis_name, None)
     fn = shard_map(
         functools.partial(
             _ring_attention_local, axis_name=axis_name, causal=causal,

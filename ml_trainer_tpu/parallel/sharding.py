@@ -28,15 +28,33 @@ def _data_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(a for a in ("data", "fsdp") if a in mesh.axis_names)
 
 
-def batch_sharding(mesh: Mesh, ndim: int = None) -> NamedSharding:
-    """Shard the leading dim over data(+fsdp) axes; replicate the rest."""
+def batch_sharding(mesh: Mesh, shard_sequence: Optional[bool] = None) -> NamedSharding:
+    """Shard the leading dim over data(+fsdp) axes.
+
+    When the mesh has a live ``sequence`` axis (sequence/context
+    parallelism), dim 1 — the token dim of [B, S] batches — shards over it
+    by default; consumers that place lower-rank arrays (labels, scalars)
+    truncate the spec to the array rank (see data.loader.prefetch_to_device).
+    """
     axes = _data_axes(mesh)
-    spec = P(axes if axes else None)
-    return NamedSharding(mesh, spec)
+    if shard_sequence is None:
+        shard_sequence = "sequence" in mesh.axis_names
+    if shard_sequence and "sequence" in mesh.axis_names:
+        return NamedSharding(mesh, P(axes if axes else None, "sequence"))
+    return NamedSharding(mesh, P(axes if axes else None))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def fit_sharding_to_rank(sharding: NamedSharding, ndim: int) -> NamedSharding:
+    """Truncate a batch sharding's spec to an array's rank — a [B, S]-shaped
+    sequence-parallel spec applies to token batches while the 1-D labels in
+    the same batch tuple keep only the batch-dim entry."""
+    if len(sharding.spec) > ndim:
+        return NamedSharding(sharding.mesh, P(*sharding.spec[:ndim]))
+    return sharding
 
 
 def path_str(path) -> str:

@@ -50,12 +50,26 @@ FSDP_RULES = [
     (r"embedding$", P(None, "fsdp")),
 ]
 
+# Expert parallelism: the stacked MoE expert weights [E, ...] shard their
+# leading (expert) dim over the expert mesh axis; the router replicates.
+# XLA turns the placement into the token dispatch/combine all-to-alls
+# (models/moe.py uses the dense GShard einsum formulation).
+EP_RULES = [
+    (r"mlp/wi$", P("expert", None, None)),
+    (r"mlp/wo$", P("expert", None, None)),
+]
+
 
 def rules_for(model_name: str, strategy: str = "tp"):
-    """Pick a rule set by model family + strategy ('tp' | 'fsdp' | 'tp+fsdp')."""
+    """Pick a rule set by model family + strategy
+    ('tp' | 'fsdp' | 'tp+fsdp' | 'ep').  EP rules ride along with tp-family
+    sets — they only bite on meshes with a live ``expert`` axis (absent
+    axes are dropped by logical_to_shardings)."""
     if strategy == "fsdp":
         return FSDP_RULES
-    rules = list(TRANSFORMER_TP_RULES)
+    if strategy == "ep":
+        return list(EP_RULES)
+    rules = list(TRANSFORMER_TP_RULES) + list(EP_RULES)
     if strategy == "tp+fsdp":
         rules += FSDP_RULES
     return rules

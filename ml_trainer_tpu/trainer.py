@@ -54,7 +54,12 @@ from ml_trainer_tpu.ops import (
     make_lr_schedule,
     PlateauController,
 )
-from ml_trainer_tpu.parallel import batch_sharding, create_mesh, replicated
+from ml_trainer_tpu.parallel import (
+    batch_sharding,
+    create_mesh,
+    fit_sharding_to_rank,
+    replicated,
+)
 from ml_trainer_tpu.parallel.distributed import (
     initialize_distributed,
     is_primary,
@@ -108,6 +113,7 @@ class Trainer:
         mesh_shape: Optional[dict] = None,
         sharding_rules=None,
         grad_accum_steps: int = 1,
+        loader: str = "auto",
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -120,7 +126,14 @@ class Trainer:
         microbatches inside the compiled step (a ``lax.scan`` over gradient
         accumulation, one optimizer update per batch) — the GPT-2 north-star
         requirement (BASELINE.json configs[4]); effective batch semantics
-        and the LR schedule's step count are unchanged."""
+        and the LR schedule's step count are unchanged.
+
+        ``loader``: 'auto' (default) assembles batches through the C++
+        NativeLoader (csrc/batch_worker.cpp — the torch DataLoader
+        worker-pool role, SURVEY.md §2B) whenever the dataset+transform can
+        run the fused native pipeline with identical semantics, else the
+        Python Loader; 'native' requires it (raises if unsupported);
+        'python' forces the Python path."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -156,6 +169,11 @@ class Trainer:
 
         logger.info("Loading the model.")
         self._sharding_rules = sharding_rules
+        if loader not in ("auto", "native", "python"):
+            raise ValueError(
+                f"loader must be 'auto' | 'native' | 'python', got {loader!r}"
+            )
+        self._loader_kind = loader
         if grad_accum_steps < 1:
             raise ValueError(f"grad_accum_steps must be >= 1, got {grad_accum_steps}")
         self.grad_accum_steps = int(grad_accum_steps)
@@ -232,24 +250,52 @@ class Trainer:
             )
         per_host = eff // process_count()
         self.global_batch = eff
-        self.train_loader = Loader(
-            train_set,
-            batch_size=per_host,
-            shuffle=train_sampler is None,
-            sampler=train_sampler,
-            drop_last=drop_last,
-            seed=cfg.seed,
+
+        def build(dataset, shuffle, sampler, seed):
+            plan = None
+            if self._loader_kind in ("auto", "native"):
+                from ml_trainer_tpu.data.native import (
+                    native_available,
+                    native_plan,
+                )
+
+                plan = native_plan(dataset)
+                if plan is not None and not native_available():
+                    plan = None
+                if plan is not None and self._loader_kind == "auto":
+                    # The native loader pads a ragged final batch by
+                    # wrapping (repeats leading samples); the Python Loader
+                    # yields a short batch.  'auto' must never change batch
+                    # semantics, so fall back unless the split is exact.
+                    n = len(sampler) if sampler is not None else len(dataset)
+                    if not drop_last and n % per_host != 0:
+                        plan = None
+                if self._loader_kind == "native" and plan is None:
+                    raise ValueError(
+                        "loader='native' requires a uint8 NHWC ArrayDataset "
+                        "with the reference augmentation pipeline (and a "
+                        "working g++); got an unsupported dataset/transform"
+                    )
+            if plan is not None:
+                from ml_trainer_tpu.data.native import NativeLoader
+
+                logger.info("Using the native (C++) input pipeline.")
+                return NativeLoader(
+                    dataset, batch_size=per_host, shuffle=shuffle,
+                    sampler=sampler, drop_last=drop_last, seed=seed, **plan,
+                )
+            return Loader(
+                dataset, batch_size=per_host, shuffle=shuffle,
+                sampler=sampler, drop_last=drop_last, seed=seed,
+            )
+
+        self.train_loader = build(
+            train_set, train_sampler is None, train_sampler, cfg.seed
         )
         # The reference evaluates the FULL validation set on every rank with
         # shuffle=True (ref: src/trainer.py:79) — kept, modulo drop_last for
         # static shapes on a sharded mesh (documented divergence).
-        self.val_loader = Loader(
-            val_set,
-            batch_size=per_host,
-            shuffle=True,
-            drop_last=drop_last,
-            seed=cfg.seed + 1,
-        )
+        self.val_loader = build(val_set, True, None, cfg.seed + 1)
         if len(self.train_loader) == 0 or len(self.val_loader) == 0:
             raise ValueError(
                 f"Loader yields no batches (train {len(self.train_loader)}, "
@@ -526,8 +572,12 @@ class Trainer:
             # Save on the primary host only (ref: src/trainer.py:252-254).
             if is_primary():
                 self.save_model(self.model_dir)
+                # Async: the write lands on the background writer thread
+                # while the next epoch trains (jax arrays are immutable, so
+                # the snapshot is consistent); fit-end joins the queue.
                 ckpt.save_checkpoint(
-                    ckpt_dir, self.state, self._partial_history(), epoch
+                    ckpt_dir, self.state, self._partial_history(), epoch,
+                    block=False,
                 )
             if self.metric:
                 logger.info(
@@ -551,6 +601,7 @@ class Trainer:
         }
         if self.save_history and is_primary():
             self.save_history_(self.model_dir)
+        ckpt.wait_for_checkpoints()
         logger.info("Training Complete.")
 
     def _partial_history(self) -> dict:
@@ -643,13 +694,21 @@ class Trainer:
         trained state."""
         logger.info("Testing..")
         module, variables = self._resolve_model(model)
+        # Key by id(module) but keep a strong reference to the module in the
+        # entry: a GC'd module's id can be recycled by a new module, which
+        # would otherwise silently reuse a stale compiled step.
         key = id(module)
-        if key not in self._eval_cache:
+        entry = self._eval_cache.get(key)
+        if entry is None or entry[0] is not module:
             takes_train = _module_takes_train(module)
-            self._eval_cache[key] = self._make_eval_step(
-                module, takes_train, has_bs="batch_stats" in variables
+            entry = (
+                module,
+                self._make_eval_step(
+                    module, takes_train, has_bs="batch_stats" in variables
+                ),
             )
-        eval_step = self._eval_cache[key]
+            self._eval_cache[key] = entry
+        eval_step = entry[1]
         n = len(test_loader)
         if n == 0:
             raise ValueError("test_loader yields no batches")
@@ -668,7 +727,10 @@ class Trainer:
             # (drop_last is their choice, ref: src/trainer.py:79 keeps all
             # samples); replicate such batches instead of failing to split.
             sharding = self._batch_sharding if shardable(batch) else self._replicated
-            return tuple(jax.device_put(a, sharding) for a in batch)
+            return tuple(
+                jax.device_put(a, fit_sharding_to_rank(sharding, np.ndim(a)))
+                for a in batch
+            )
 
         batches = map(place, test_loader)
         with tqdm(batches, total=n, unit="batch") as tepoch:

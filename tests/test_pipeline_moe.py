@@ -1,0 +1,132 @@
+"""Pipeline parallelism (GPipe scan over the ``stage`` axis) and
+expert-parallel MoE — the two strategies VERDICT r1 #10 required behind the
+reserved mesh axes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ml_trainer_tpu import Trainer
+from ml_trainer_tpu.data import SyntheticTokens
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.models.moe import MoEMLP
+from ml_trainer_tpu.parallel import (
+    create_mesh,
+    pipeline_apply,
+    rules_for,
+    stack_stage_params,
+)
+
+
+# ----------------------------------------------------------------- pipeline
+def _stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(n_stages, width, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {
+            "w": jnp.asarray(rng.normal(0, 0.5, (width, width)), jnp.float32),
+            "b": jnp.asarray(rng.normal(0, 0.1, (width,)), jnp.float32),
+        }
+        for _ in range(n_stages)
+    ]
+
+
+def _serial(stages, x):
+    for p in stages:
+        x = _stage_fn(p, x)
+    return x
+
+
+@pytest.mark.parametrize("n_micro", [4, 8])
+def test_pipeline_matches_serial(n_micro):
+    mesh = create_mesh({"stage": 4}, devices=jax.devices()[:4])
+    stages = _make_stages(4, 16)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(16, 16)), jnp.float32
+    )
+    out = pipeline_apply(
+        _stage_fn, stacked, x, mesh, n_microbatches=n_micro
+    )
+    np.testing.assert_allclose(out, _serial(stages, x), atol=1e-5, rtol=1e-5)
+
+
+def test_pipeline_under_jit_and_grad():
+    """The schedule is one lax.scan: jit-able and reverse-differentiable —
+    gradients equal the serial composition's."""
+    mesh = create_mesh({"stage": 4}, devices=jax.devices()[:4])
+    stages = _make_stages(4, 8, seed=2)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(8, 8)), jnp.float32)
+
+    def loss_pipe(p):
+        return jnp.sum(pipeline_apply(_stage_fn, p, x, mesh) ** 2)
+
+    def loss_serial(ps):
+        return jnp.sum(_serial(ps, x) ** 2)
+
+    g_pipe = jax.jit(jax.grad(loss_pipe))(stacked)
+    g_serial = jax.grad(loss_serial)(stages)
+    g_serial_stacked = stack_stage_params(g_serial)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_serial_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pipeline_rejects_indivisible_batch():
+    mesh = create_mesh({"stage": 4}, devices=jax.devices()[:4])
+    stacked = stack_stage_params(_make_stages(4, 8))
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline_apply(
+            _stage_fn, stacked, jnp.ones((6, 8)), mesh, n_microbatches=4
+        )
+
+
+# ---------------------------------------------------------------------- moe
+def test_moe_single_expert_equals_dense_mlp():
+    """E=1 with ample capacity: routing is the identity, so the MoE layer is
+    exactly its one expert MLP (gate prob = softmax over 1 = 1.0)."""
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 8, 16)), jnp.float32
+    )
+    moe = MoEMLP(num_experts=1, hidden_dim=32, capacity_factor=2.0)
+    variables = moe.init({"params": jax.random.PRNGKey(0)}, x)
+    out = moe.apply(variables, x)
+    p = variables["params"]
+    ref = jax.nn.gelu(x @ p["wi"][0]) @ p["wo"][0]
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_moe_routes_and_balances():
+    x = jnp.asarray(
+        np.random.default_rng(1).normal(size=(4, 16, 32)), jnp.float32
+    )
+    moe = MoEMLP(num_experts=4, hidden_dim=64)
+    variables = moe.init({"params": jax.random.PRNGKey(1)}, x)
+    out, state = moe.apply(variables, x, mutable=["losses"])
+    assert out.shape == x.shape
+    aux = state["losses"]["moe_aux_loss"][0]
+    # Aux loss is >= 1 (perfect balance) by Cauchy-Schwarz; finite.
+    assert float(aux) >= 0.99 and np.isfinite(float(aux))
+
+
+def test_moe_trains_expert_parallel(tmp_path):
+    """gpt2_moe_tiny trains on a {data:2, expert:4} mesh with EP rules:
+    expert weights really shard the expert axis and the loss is finite."""
+    from jax.sharding import PartitionSpec as P
+
+    ds = SyntheticTokens(size=32, seq_len=32, vocab_size=1024, seed=0)
+    t = Trainer(
+        get_model("gpt2_moe_tiny"), datasets=(ds, ds),
+        model_dir=str(tmp_path), is_parallel=True, backend="cpu",
+        mesh_shape={"data": 2, "expert": 4},
+        sharding_rules=rules_for("gpt2", "ep"),
+        epochs=1, batch_size=8, metric=None, optimizer="adamw",
+    )
+    wi = t.state.params["block0"]["mlp"]["wi"]
+    assert wi.sharding.spec == P("expert", None, None)
+    t.fit()
+    assert np.isfinite(t.train_losses[0])
