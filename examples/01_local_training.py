@@ -39,6 +39,9 @@ def build_datasets():
 
 
 def main():
+    import json
+    import time
+
     datasets = build_datasets()
     # The reference notebook's config dict (01 nb cell-8).
     config = {
@@ -57,7 +60,9 @@ def main():
         MLModel(), datasets=datasets, epochs=6, batch_size=32,
         save_history=True, **config,
     )
+    t0 = time.perf_counter()
     trainer.fit()
+    fit_secs = time.perf_counter() - t0
 
     history = load_history(MODEL_DIR)
     print({k: v[-1] if isinstance(v, list) else v for k, v in history.items()})
@@ -68,6 +73,29 @@ def main():
     test_loader = Loader(datasets[1], batch_size=32, shuffle=True)
     test_loss, test_acc = trainer.test(loaded, test_loader)
     print(f"test loss {test_loss:.4f}  accuracy {test_acc:.4f}")
+
+    # Golden-run capture (the analog of the reference's committed notebook
+    # outputs, 01 nb cell-12/16): history + test metrics + throughput, used
+    # by tests/test_golden.py as the regression baseline.
+    golden_out = os.environ.get("GOLDEN_OUT")
+    if golden_out:
+        import jax
+
+        n_train = len(datasets[0]) * trainer.epochs
+        record = {
+            "backend": jax.default_backend(),
+            "synthetic": type(datasets[0]).__name__ == "SyntheticCIFAR10",
+            "train_size": len(datasets[0]),
+            "epochs": trainer.epochs,
+            "history": history,
+            "test_loss": float(test_loss),
+            "test_accuracy": float(test_acc),
+            "fit_wall_secs": round(fit_secs, 2),
+            "train_samples_per_sec_incl_compile": round(n_train / fit_secs, 1),
+        }
+        with open(golden_out, "w") as f:
+            json.dump(record, f, indent=1, default=float)
+        print(f"golden record -> {golden_out}")
 
 
 if __name__ == "__main__":

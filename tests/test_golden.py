@@ -1,0 +1,82 @@
+"""Golden-run regression check (VERDICT r1 #8).
+
+The reference's committed notebook outputs (01 nb cell-12/16: per-epoch
+loss/accuracy + throughput lines) act as its golden-run record.  Ours is
+``tests/golden/local_run_tpu.json`` — captured by running
+``GOLDEN_OUT=... python examples/01_local_training.py`` on the real TPU
+chip (synthetic CIFAR-10, the zero-egress stand-in).  This test re-runs the
+exact same configuration on the CPU test mesh and asserts the trajectory
+still lands where the committed record says, within tolerances generous
+enough to absorb CPU-vs-TPU numerics but tight enough to catch real
+regressions (broken schedule stepping, loss scaling, seeding, history
+schema).
+"""
+
+import json
+import os
+
+import pytest
+
+GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "local_run_tpu.json")
+
+HISTORY_KEYS = {
+    "epochs", "train_loss", "val_loss", "train_metric", "val_metric",
+    "metric_type",
+}
+
+
+@pytest.fixture(scope="module")
+def golden():
+    if not os.path.exists(GOLDEN):
+        pytest.skip("golden record not captured yet")
+    with open(GOLDEN) as f:
+        return json.load(f)
+
+
+def test_golden_schema(golden):
+    assert set(golden["history"]) == HISTORY_KEYS
+    n = golden["epochs"]
+    assert golden["history"]["epochs"] == list(range(1, n + 1))
+    for k in ("train_loss", "val_loss", "train_metric", "val_metric"):
+        assert len(golden["history"][k]) == n
+    assert golden["history"]["metric_type"] == "accuracy"
+    assert golden["train_samples_per_sec_incl_compile"] > 0
+
+
+def test_golden_trajectory_reproduces(golden, tmp_path):
+    """Same config, same seeds, CPU mesh — must match the TPU record."""
+    from ml_trainer_tpu import MLModel, Loader, Trainer, load_model
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+    from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+    if not golden.get("synthetic"):
+        pytest.skip("golden record was captured on real CIFAR-10, which "
+                    "this machine may not have")
+    transform = custom_pre_process_function()
+    datasets = (
+        SyntheticCIFAR10(size=golden["train_size"], transform=transform),
+        SyntheticCIFAR10(size=512, transform=transform, seed=1),
+    )
+    trainer = Trainer(
+        MLModel(), datasets=datasets, epochs=golden["epochs"], batch_size=32,
+        save_history=True, seed=32, scheduler="CosineAnnealingWarmRestarts",
+        optimizer="sgd", momentum=0.9, weight_decay=0.0, lr=0.001,
+        criterion="cross_entropy", metric="accuracy", pred_function="softmax",
+        model_dir=str(tmp_path),
+    )
+    trainer.fit()
+
+    h, g = trainer.history, golden["history"]
+    assert set(h) == set(g)
+    assert h["epochs"] == g["epochs"]
+    # Full per-epoch trajectory, not just the endpoint.
+    for k, tol in (("train_loss", 0.2), ("val_loss", 0.2),
+                   ("train_metric", 0.1), ("val_metric", 0.1)):
+        for ours, theirs in zip(h[k], g[k]):
+            assert abs(ours - theirs) < tol, (k, h[k], g[k])
+
+    loaded = load_model(MLModel(), str(tmp_path))
+    test_loader = Loader(datasets[1], batch_size=32, shuffle=True)
+    test_loss, test_acc = trainer.test(loaded, test_loader)
+    assert abs(float(test_loss) - golden["test_loss"]) < 0.2
+    assert abs(float(test_acc) - golden["test_accuracy"]) < 0.1
