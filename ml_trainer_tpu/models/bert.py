@@ -31,6 +31,7 @@ class BertEncoder(nn.Module):
     pad_token_id: int = 0
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
+    remat: bool = False  # jax.checkpoint each block (backward recompute)
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -58,13 +59,18 @@ class BertEncoder(nn.Module):
         if attention_mask is not None:
             # [B, S] (1 = real token) -> [B, 1, 1, S] broadcastable boolean.
             mask = attention_mask[:, None, None, :].astype(bool)
+        Block = (
+            nn.remat(TransformerBlock, static_argnums=(3,))
+            if self.remat
+            else TransformerBlock
+        )
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = Block(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
                 dropout_rate=self.dropout_rate, post_norm=True,
                 dtype=self.dtype, attention_impl=self.attention_impl,
                 name=f"layer{i}",
-            )(x, mask=mask, train=train)
+            )(x, mask, train)
         if self.num_classes is None:
             return x  # sequence output (feature-extractor mode)
         pooled = jnp.tanh(

@@ -28,6 +28,8 @@ class GPT2(nn.Module):
     attention_impl: str = "auto"
     mesh: object = None  # jax Mesh; needed for attention_impl='ring'
     moe_experts: int = 0  # >0: MoE feed-forward in every block (EP axis)
+    remat: bool = False  # jax.checkpoint each block: O(depth) -> O(1)
+    # layer activations live in HBM during backward (long-context lever)
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
@@ -41,13 +43,20 @@ class GPT2(nn.Module):
         x = (x + pos[:, :s]).astype(self.dtype)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        # remat: recompute each block's activations in the backward pass
+        # instead of keeping them in HBM (jax.checkpoint; train arg static).
+        Block = (
+            nn.remat(TransformerBlock, static_argnums=(3,))
+            if self.remat
+            else TransformerBlock
+        )
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = Block(
                 num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
                 causal=True, dropout_rate=self.dropout_rate, dtype=self.dtype,
                 attention_impl=self.attention_impl, mesh=self.mesh,
                 moe_experts=self.moe_experts, name=f"block{i}",
-            )(x, train=train)
+            )(x, None, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
         # Tied LM head: reuse the token embedding matrix.
         logits = x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)

@@ -26,6 +26,7 @@ class VisionTransformer(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
+    remat: bool = False  # jax.checkpoint each block (backward recompute)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -42,12 +43,17 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(x.dtype)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        Block = (
+            nn.remat(TransformerBlock, static_argnums=(3,))
+            if self.remat
+            else TransformerBlock
+        )
         for i in range(self.depth):
-            x = TransformerBlock(
+            x = Block(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
                 dropout_rate=self.dropout_rate, dtype=self.dtype,
                 attention_impl=self.attention_impl, name=f"block{i}",
-            )(x, train=train)
+            )(x, None, train)
         x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x[:, 0])
 

@@ -95,6 +95,28 @@ def enable_compilation_cache(path: str = "/tmp/ml_trainer_tpu_jax_cache") -> Non
         pass
 
 
+def _chunk_batches(loader, k: int, tail: list):
+    """Yield [K, B, ...] stacks of full batches; ragged batches (and the
+    final partial chunk) land in ``tail`` once the generator drains."""
+    xs, ys = [], []
+    full = None  # leading dim of a full batch (first seen)
+    for x, y in loader:
+        if full is None:
+            full = x.shape[0]
+        if x.shape[0] != full:
+            # Ragged final batch (drop_last=False): un-stackable, so it
+            # always goes through the per-batch tail path even when it
+            # would land inside a full chunk.
+            tail.append((x, y))
+            continue
+        xs.append(x)
+        ys.append(y)
+        if len(xs) == k:
+            yield np.stack(xs), np.stack(ys)
+            xs, ys = [], []
+    tail.extend(zip(xs, ys))
+
+
 def _module_takes_train(module) -> bool:
     import inspect
 
@@ -421,8 +443,9 @@ class Trainer:
             self._stacked_sharding = jax.sharding.NamedSharding(
                 self.mesh, P(None, *spec)
             )
-        self._eval_step = self._make_eval_step(
-            self.model, self._takes_train, self._has_batch_stats
+        self._eval_step, self._eval_multi_step = self._make_eval_step(
+            self.model, self._takes_train, self._has_batch_stats,
+            multi=self.steps_per_execution > 1,
         )
 
     def _make_train_step(self):
@@ -505,10 +528,13 @@ class Trainer:
 
         return train_step
 
-    def _make_eval_step(self, module, takes_train, has_bs):
+    def _make_eval_step(self, module, takes_train, has_bs, multi=False):
+        """Compiled eval step for ``module``; with ``multi`` also returns
+        the K-batches-per-dispatch variant (scan), else None.  Pure — no
+        trainer state is touched (test() builds steps for foreign modules
+        through this too)."""
         criterion, metric_fn = self.criterion, self.metric_fn
 
-        @jax.jit
         def eval_step(variables, x, y):
             kwargs = {"train": False} if takes_train else {}
             out = module.apply(variables, x, **kwargs)
@@ -518,7 +544,17 @@ class Trainer:
             )
             return loss, metric_val
 
-        return eval_step
+        eval_multi = None
+        if multi:
+            def eval_multi_fn(variables, xs, ys):
+                def body(_, xy):
+                    return 0, eval_step(variables, *xy)
+
+                _, (losses, metrics) = jax.lax.scan(body, 0, (xs, ys))
+                return losses.sum(), metrics.sum()
+
+            eval_multi = jax.jit(eval_multi_fn)
+        return jax.jit(eval_step), eval_multi
 
     def _state_variables(self) -> dict:
         variables = {"params": self.state.params}
@@ -572,27 +608,9 @@ class Trainer:
         metric_sum = jnp.zeros(())
         tail: list = []  # ragged final batches, filled once chunks() drains
 
-        def chunks():
-            xs, ys = [], []
-            full = None  # leading dim of a full batch (first seen)
-            for x, y in self.train_loader:
-                if full is None:
-                    full = x.shape[0]
-                if x.shape[0] != full:
-                    # Ragged final batch (drop_last=False): un-stackable, so
-                    # it always goes through the per-batch tail path even
-                    # when it would land inside a full chunk.
-                    tail.append((x, y))
-                    continue
-                xs.append(x)
-                ys.append(y)
-                if len(xs) == k:
-                    yield np.stack(xs), np.stack(ys)
-                    xs, ys = [], []
-            tail.extend(zip(xs, ys))
-
         stacked = prefetch_to_device(
-            chunks(), size=2, sharding=self._stacked_sharding
+            _chunk_batches(self.train_loader, k, tail),
+            size=2, sharding=self._stacked_sharding,
         )
         with tqdm(total=n, unit="batch") as tepoch:
             done = 0
@@ -635,21 +653,60 @@ class Trainer:
         loss_sum = jnp.zeros(())
         metric_sum = jnp.zeros(())
         variables = self._state_variables()
-        batches = prefetch_to_device(
-            self.val_loader, size=2, sharding=self._batch_sharding
-        )
-        with tqdm(batches, total=n, unit="batch") as tepoch:
-            for i, (x, y) in enumerate(tepoch):
-                loss, metric_val = self._eval_step(variables, x, y)
-                loss_sum = loss_sum + loss
-                metric_sum = metric_sum + metric_val
-                if (i + 1) % self.log_every == 0 or (i + 1) == n:
-                    if self.metric:
-                        tepoch.set_postfix(
-                            loss=float(loss_sum) / n, metric=float(metric_sum) / n
-                        )
-                    else:
-                        tepoch.set_postfix(loss=float(loss))
+        k = self.steps_per_execution
+        if k > 1:
+            tail: list = []
+            with tqdm(total=n, unit="batch") as tepoch:
+                done = 0
+
+                def log(step_n, loss):
+                    if done % max(self.log_every, k) < step_n or done == n:
+                        if self.metric:
+                            tepoch.set_postfix(
+                                loss=float(loss_sum) / n,
+                                metric=float(metric_sum) / n,
+                            )
+                        else:
+                            # Mean loss of the last dispatch — the analog of
+                            # the single-step path's last-batch loss.
+                            tepoch.set_postfix(loss=float(loss) / step_n)
+
+                for xs, ys in prefetch_to_device(
+                    _chunk_batches(self.val_loader, k, tail),
+                    size=2, sharding=self._stacked_sharding,
+                ):
+                    loss, metric_val = self._eval_multi_step(variables, xs, ys)
+                    loss_sum = loss_sum + loss
+                    metric_sum = metric_sum + metric_val
+                    done += k
+                    tepoch.update(k)
+                    log(k, loss)
+                for x, y in prefetch_to_device(
+                    iter(tail), size=2, sharding=self._batch_sharding
+                ):
+                    loss, metric_val = self._eval_step(variables, x, y)
+                    loss_sum = loss_sum + loss
+                    metric_sum = metric_sum + metric_val
+                    done += 1
+                    tepoch.update(1)
+                    log(1, loss)
+        else:
+            batches = prefetch_to_device(
+                self.val_loader, size=2, sharding=self._batch_sharding
+            )
+            with tqdm(batches, total=n, unit="batch") as tepoch:
+                for i, (x, y) in enumerate(tepoch):
+                    loss, metric_val = self._eval_step(variables, x, y)
+                    loss_sum = loss_sum + loss
+                    metric_sum = metric_sum + metric_val
+                    if (i + 1) % self.log_every == 0 or (i + 1) == n:
+                        if self.metric:
+                            tepoch.set_postfix(
+                                loss=float(loss_sum) / n,
+                                metric=float(metric_sum) / n,
+                            )
+                        else:
+                            tepoch.set_postfix(loss=float(loss))
         self.val_losses.append(float(loss_sum) / n)
         if self.metric:
             self.val_metrics.append(float(metric_sum) / n)
@@ -814,7 +871,7 @@ class Trainer:
                 module,
                 self._make_eval_step(
                     module, takes_train, has_bs="batch_stats" in variables
-                ),
+                )[0],
             )
             self._eval_cache[key] = entry
         eval_step = entry[1]

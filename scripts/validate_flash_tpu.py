@@ -1,0 +1,92 @@
+"""Validate the Pallas flash-attention kernels on REAL TPU hardware.
+
+Closes the round-1 gap "flash kernel has no TPU validation on record"
+(tests exercise interpret mode only): runs the Mosaic-compiled forward and
+backward kernels on the chip, checks them against the XLA
+dot_product_attention path (values + all three input grads), and times
+both.  Writes a JSON record to docs/flash_tpu_validation.json so the
+result is committed evidence, not a claim.
+
+    python scripts/validate_flash_tpu.py
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from ml_trainer_tpu.ops.attention import dot_product_attention, flash_attention  # noqa: E402
+
+
+def bench(fn, *args, iters=20):
+    jax.block_until_ready(fn(*args))  # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    assert jax.default_backend() == "tpu", (
+        f"needs the real TPU, got {jax.default_backend()}"
+    )
+    record = {"device": str(jax.devices()[0]), "cases": []}
+    rng = np.random.default_rng(0)
+    for (b, h, s, d), causal in [
+        ((2, 4, 512, 64), False),
+        ((2, 4, 512, 64), True),
+        ((1, 12, 2048, 64), True),   # GPT-2-ish long context
+    ]:
+        q, k, v = (
+            jnp.asarray(rng.normal(size=(b, h, s, d)) * 0.5, jnp.float32)
+            for _ in range(3)
+        )
+
+        def loss_flash(q, k, v):
+            return flash_attention(q, k, v, causal).sum()
+
+        def loss_xla(q, k, v):
+            return dot_product_attention(q, k, v, causal=causal).sum()
+
+        f_fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal))
+        x_fwd = jax.jit(lambda q, k, v: dot_product_attention(q, k, v, causal=causal))
+        f_grad = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        x_grad = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))
+
+        of, ox = f_fwd(q, k, v), x_fwd(q, k, v)
+        fwd_err = float(jnp.max(jnp.abs(of - ox)))
+        gf, gx = f_grad(q, k, v), x_grad(q, k, v)
+        grad_err = float(
+            max(jnp.max(jnp.abs(a - b)) for a, b in zip(gf, gx))
+        )
+        t_f = bench(f_fwd, q, k, v)
+        t_x = bench(x_fwd, q, k, v)
+        t_fg = bench(f_grad, q, k, v)
+        t_xg = bench(x_grad, q, k, v)
+        case = {
+            "shape": [b, h, s, d], "causal": causal,
+            "fwd_max_abs_err": fwd_err, "grad_max_abs_err": grad_err,
+            "fwd_ms": {"flash": round(t_f * 1e3, 3), "xla": round(t_x * 1e3, 3)},
+            "grad_ms": {"flash": round(t_fg * 1e3, 3), "xla": round(t_xg * 1e3, 3)},
+            "pass": fwd_err < 2e-3 and grad_err < 2e-2,
+        }
+        record["cases"].append(case)
+        print(case, flush=True)
+    record["all_pass"] = all(c["pass"] for c in record["cases"])
+    out = os.path.join(ROOT, "docs", "flash_tpu_validation.json")
+    with open(out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(f"-> {out}  all_pass={record['all_pass']}")
+    sys.exit(0 if record["all_pass"] else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -111,3 +111,23 @@ def test_gpt2_param_count_is_124m():
     variables = model.init({"params": jax.random.PRNGKey(0)}, ids, train=False)
     n_params = sum(p.size for p in jax.tree.leaves(variables["params"]))
     assert 123_000_000 < n_params < 125_000_000  # 124M with tied head
+
+
+def test_remat_gradients_match():
+    """remat=True (jax.checkpoint per block) must not change values or
+    gradients — only when activations are recomputed."""
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 128, (2, 16)), jnp.int32)
+    kw = dict(vocab_size=128, embed_dim=32, depth=2, num_heads=2, max_len=32)
+    m0 = get_model("gpt2_tiny", **kw)
+    m1 = get_model("gpt2_tiny", remat=True, **kw)
+    v = m0.init({"params": jax.random.PRNGKey(0)}, ids, train=False)
+
+    def loss(params, model):
+        return model.apply({"params": params}, ids, train=True).sum()
+
+    l0, g0 = jax.value_and_grad(loss)(v["params"], m0)
+    l1, g1 = jax.value_and_grad(loss)(v["params"], m1)
+    assert np.allclose(l0, l1, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        assert np.allclose(a, b, rtol=1e-5, atol=1e-6)
