@@ -1,6 +1,7 @@
 from ml_trainer_tpu.checkpoint.checkpoint import (
     CHECKPOINT_PREFIX,
     MODEL_FILE,
+    fetch_to_host,
     latest_checkpoint,
     load_model_variables,
     prune_checkpoints,
@@ -14,6 +15,7 @@ from ml_trainer_tpu.checkpoint.torch_import import load_torch_checkpoint
 __all__ = [
     "CHECKPOINT_PREFIX",
     "MODEL_FILE",
+    "fetch_to_host",
     "latest_checkpoint",
     "load_model_variables",
     "prune_checkpoints",

@@ -64,7 +64,7 @@ def save_model_variables(model_dir: str, variables: Any) -> str:
     """Weights-only export, every-epoch cadence (ref: src/trainer.py:232-235)."""
     os.makedirs(model_dir, exist_ok=True)
     path = os.path.join(model_dir, MODEL_FILE)
-    _atomic_write(path, serialization.to_bytes(jax.device_get(variables)))
+    _atomic_write(path, serialization.to_bytes(fetch_to_host(variables)))
     return path
 
 
@@ -135,6 +135,25 @@ def wait_for_checkpoints() -> None:
         fut.result()
 
 
+def fetch_to_host(tree: Any) -> Any:
+    """Device→host snapshot that survives host-spanning shardings.
+
+    ``jax.device_get`` raises on arrays that are not fully addressable
+    (e.g. ZeRO-1 optimizer moments sharded over a multi-host ``data``
+    axis); those leaves are gathered across processes first.  Single-host
+    arrays take the plain fast path."""
+    def fetch(leaf):
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(leaf, tiled=True)
+            )
+        return jax.device_get(leaf)
+
+    return jax.tree.map(fetch, tree)
+
+
 def save_checkpoint(
     ckpt_dir: str,
     state: Any,
@@ -152,7 +171,7 @@ def save_checkpoint(
     import copy
 
     os.makedirs(ckpt_dir, exist_ok=True)
-    state_dict = jax.device_get(serialization.to_state_dict(state))
+    state_dict = fetch_to_host(serialization.to_state_dict(state))
     # Deep-copy on the caller's thread: the trainer hands us its LIVE
     # history lists, which the next epoch mutates while the writer runs.
     history = copy.deepcopy(history)

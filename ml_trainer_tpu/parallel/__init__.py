@@ -22,7 +22,9 @@ from ml_trainer_tpu.parallel.sharding import (
     batch_sharding,
     fit_sharding_to_rank,
     replicated,
+    shard_opt_state,
     shard_params,
+    zero1_opt_shardings,
     logical_to_shardings,
 )
 from ml_trainer_tpu.parallel import collectives
@@ -53,7 +55,9 @@ __all__ = [
     "batch_sharding",
     "fit_sharding_to_rank",
     "replicated",
+    "shard_opt_state",
     "shard_params",
+    "zero1_opt_shardings",
     "logical_to_shardings",
     "collectives",
 ]

@@ -111,3 +111,57 @@ def shard_params(params, mesh: Mesh, rules: Optional[Rules] = None):
     """Materialize a parameter tree onto the mesh under the given rules."""
     shardings = logical_to_shardings(params, mesh, rules)
     return jax.tree.map(jax.device_put, params, shardings)
+
+
+def shard_opt_state(opt_state, mesh: Mesh, axis: str = "data"):
+    """ZeRO-1-style optimizer-state sharding as a pure placement decision.
+
+    Re-places every optimizer-state leaf that is currently fully replicated
+    and whose leading dim divides the ``axis`` size so dim 0 is partitioned
+    over that mesh axis; XLA's SPMD partitioner then turns the weight
+    update into compute on 1/N of the moments per device with the
+    collectives it implies (the technique of "Automatic Cross-Replica
+    Sharding of Weight Update in Data-Parallel Training" — here it is just
+    a sharding annotation, not a rewrite).  Values are bit-identical to the
+    replicated layout; only memory/placement changes.  Leaves already
+    sharded by TP/FSDP rules (momenta inherit their param's sharding) are
+    left alone.
+    """
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return opt_state
+    n = mesh.shape[axis]
+
+    def place(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return leaf
+        current = getattr(leaf, "sharding", None)
+        if isinstance(current, NamedSharding) and any(
+            s is not None for s in current.spec
+        ):
+            return leaf  # already model-sharded; don't fight the rules
+        if leaf.shape[0] % n:
+            return leaf
+        return jax.device_put(leaf, NamedSharding(mesh, P(axis)))
+
+    return jax.tree.map(place, opt_state)
+
+
+def zero1_opt_shardings(opt_shapes, mesh: Mesh, axis: str = "data"):
+    """Target shardings for a pure-DP ZeRO-1 optimizer state, decided from
+    ``jax.eval_shape(tx.init, params)`` so init can be jitted with
+    ``out_shardings`` and the moments are born partitioned (never
+    materialized replicated).  Shape-based rule: leading dim divisible by
+    the axis size → P(axis); everything else replicated.  Only valid when
+    params are replicated (no TP/FSDP rules) — rule-sharded params need the
+    materialized-placement path instead."""
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return jax.tree.map(lambda _: NamedSharding(mesh, P()), opt_shapes)
+    n = mesh.shape[axis]
+
+    def target(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) and shape[0] % n == 0 and shape[0] > 0:
+            return NamedSharding(mesh, P(axis))
+        return NamedSharding(mesh, P())
+
+    return jax.tree.map(target, opt_shapes)

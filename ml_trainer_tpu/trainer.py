@@ -140,6 +140,7 @@ class Trainer:
         grad_accum_steps: int = 1,
         loader: str = "auto",
         steps_per_execution: int = 1,
+        shard_opt_state: bool = False,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -167,7 +168,13 @@ class Trainer:
         history are bit-identical to ``steps_per_execution=1``; only the
         per-step Python/dispatch overhead is amortized — the lever that
         matters for small models, where the reference pays a full
-        host round-trip per batch (ref: src/trainer.py:186)."""
+        host round-trip per batch (ref: src/trainer.py:186).
+
+        ``shard_opt_state``: ZeRO-1-style placement — replicated optimizer
+        moments are partitioned over the ``data`` mesh axis (a sharding
+        annotation; XLA inserts the implied collectives), cutting optimizer
+        memory per device by the data-parallel degree with an identical
+        update sequence."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -216,6 +223,7 @@ class Trainer:
                 f"steps_per_execution must be >= 1, got {steps_per_execution}"
             )
         self.steps_per_execution = int(steps_per_execution)
+        self._shard_opt_state = bool(shard_opt_state)
         if self.is_parallel:
             # Rendezvous — the init_process_group analog (ref: src/trainer.py:59).
             initialize_distributed(cfg.backend)
@@ -408,12 +416,33 @@ class Trainer:
             batch_stats = shard_params(
                 batch_stats, self.mesh, self._sharding_rules
             )
-        opt_state = jax.tree.map(
-            lambda x: x
-            if isinstance(getattr(x, "sharding", None), jax.sharding.NamedSharding)
-            else jax.device_put(x, self._replicated),
-            self.tx.init(params),
-        )
+        if self._shard_opt_state and self._sharding_rules is None:
+            # Pure-DP ZeRO-1: decide shardings from SHAPES and jit-init with
+            # out_shardings so the moments are BORN partitioned — the full
+            # replicated tree never materializes (tx.init would otherwise be
+            # the peak-memory moment on exactly the memory-bound runs this
+            # flag exists for).
+            from ml_trainer_tpu.parallel import zero1_opt_shardings
+
+            out_sh = zero1_opt_shardings(
+                jax.eval_shape(self.tx.init, params), self.mesh
+            )
+            opt_state = jax.jit(self.tx.init, out_shardings=out_sh)(params)
+        else:
+            opt_state = jax.tree.map(
+                lambda x: x
+                if isinstance(
+                    getattr(x, "sharding", None), jax.sharding.NamedSharding
+                )
+                else jax.device_put(x, self._replicated),
+                self.tx.init(params),
+            )
+            if self._shard_opt_state:
+                # Model-sharded params (TP/FSDP rules): re-place only the
+                # still-replicated leaves, leaving rule-sharded moments be.
+                from ml_trainer_tpu.parallel import shard_opt_state as _shard_opt
+
+                opt_state = _shard_opt(opt_state, self.mesh)
         self.state = TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), self._replicated),
             params=params,
@@ -813,10 +842,10 @@ class Trainer:
             return 1
         if latest is not None:
             state, saved, done_epoch = ckpt.restore_checkpoint(
-                latest, jax.device_get(self.state)
+                latest, ckpt.fetch_to_host(self.state)
             )
         else:  # non-primary host without the file; overwritten by broadcast
-            state, saved, done_epoch = jax.device_get(self.state), {}, 0
+            state, saved, done_epoch = ckpt.fetch_to_host(self.state), {}, 0
         plateau = saved.get("plateau", {})
         scalars = np.asarray(
             [
