@@ -1,0 +1,76 @@
+"""GPT-2 pretraining — the north-star LM config (BASELINE.json configs[4]:
+gradient accumulation + checkpoint save/restore), with every memory/perf
+lever of the framework on one command line.
+
+    python examples/04_gpt2_pretrain.py                  # tiny model, smoke
+    MODEL=gpt2 SEQ_LEN=1024 BATCH=8 ACCUM=4 REMAT=1 \
+        python examples/04_gpt2_pretrain.py              # the real config
+    RESUME=1 python examples/04_gpt2_pretrain.py         # continue from ckpt
+
+Levers (env vars): ACCUM (microbatches per update, compiled scan), REMAT
+(jax.checkpoint per block), ZERO1 (optimizer-state sharding over data),
+K (steps per dispatch), TP (tensor-parallel degree over a dp*tp mesh).
+"""
+
+import os
+
+import jax
+
+from ml_trainer_tpu import Trainer
+from ml_trainer_tpu.data import SyntheticTokens
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.parallel import rules_for
+
+MODEL = os.environ.get("MODEL", "gpt2_tiny")
+SEQ_LEN = int(os.environ.get("SEQ_LEN", "128"))
+BATCH = int(os.environ.get("BATCH", "16"))
+EPOCHS = int(os.environ.get("EPOCHS", "2"))
+ACCUM = int(os.environ.get("ACCUM", "2"))
+TP = int(os.environ.get("TP", "1"))
+MODEL_DIR = os.environ.get("MODEL_DIR", "model_output_gpt2")
+
+
+def main():
+    n = int(os.environ.get("SYNTH_SIZE", "512"))
+    vocab = int(os.environ.get("VOCAB", "1024"))
+    # Causal-LM pairs: labels are the inputs shifted left (SyntheticTokens
+    # emits them already shifted when num_classes is None).
+    datasets = (
+        SyntheticTokens(size=n, seq_len=SEQ_LEN, vocab_size=vocab),
+        SyntheticTokens(size=max(n // 8, 32), seq_len=SEQ_LEN,
+                        vocab_size=vocab, seed=1),
+    )
+    model_kw = dict(remat=os.environ.get("REMAT") == "1")
+    if MODEL == "gpt2_tiny":
+        model_kw.update(vocab_size=vocab, max_len=SEQ_LEN)
+    mesh_shape = None
+    sharding_rules = None
+    if TP > 1:
+        mesh_shape = {"data": jax.device_count() // TP, "tensor": TP}
+        sharding_rules = rules_for("gpt2", "tp")
+    trainer = Trainer(
+        get_model(MODEL, **model_kw),
+        datasets=datasets,
+        epochs=EPOCHS,
+        batch_size=BATCH,
+        is_parallel=os.environ.get("PARALLEL") == "1",
+        save_history=True,
+        grad_accum_steps=ACCUM,
+        steps_per_execution=int(os.environ.get("K", "1")),
+        shard_opt_state=os.environ.get("ZERO1") == "1",
+        mesh_shape=mesh_shape,
+        sharding_rules=sharding_rules,
+        optimizer="adamw",
+        lr=float(os.environ.get("LR", "3e-4")),
+        weight_decay=0.01,
+        criterion="cross_entropy",
+        scheduler="CosineAnnealingWarmRestarts",
+        model_dir=MODEL_DIR,
+    )
+    trainer.fit(resume=os.environ.get("RESUME") == "1")
+    print({k: (v[-1] if isinstance(v, list) else v)
+           for k, v in trainer.history.items()})
+
+
+if __name__ == "__main__":
+    main()

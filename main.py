@@ -71,7 +71,14 @@ def main(args) -> None:
         steps_per_execution=args.steps_per_execution,
         **config,
     )
-    trainer.fit(resume=args.resume)
+    if args.profile:
+        from ml_trainer_tpu.utils.profiler import trace
+
+        with trace(args.profile):
+            trainer.fit(resume=args.resume)
+        print(f"profiler trace -> {args.profile} (load in TensorBoard)")
+    else:
+        trainer.fit(resume=args.resume)
 
 
 def parse_args(argv=None):
@@ -115,6 +122,9 @@ def parse_args(argv=None):
                         help="use deterministic synthetic CIFAR-10 data")
     parser.add_argument("--synthetic_train_size", type=int, default=2048)
     parser.add_argument("--synthetic_val_size", type=int, default=512)
+    parser.add_argument("--profile", type=str, default=None,
+                        help="directory for a jax.profiler trace of the "
+                             "whole fit (TensorBoard-loadable)")
     parser.add_argument("--steps_per_execution", type=int, default=1,
                         help="optimizer steps per device dispatch "
                              "(lax.scan inside one compiled program; "
