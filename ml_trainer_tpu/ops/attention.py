@@ -424,31 +424,39 @@ def attention(
     """Dispatch between the Pallas flash kernel, ring sequence parallelism
     and the XLA path.
 
-    ``implementation``: 'auto' | 'xla' | 'flash' | 'ring'.  Arbitrary masks
-    always take the XLA path (the flash kernel handles the causal mask
-    only); requesting 'flash' with a mask is an error rather than a silent
-    drop.  The flash kernel also requires s_q == s_k — its causal mask is
-    aligned to the main diagonal, whereas the XLA path uses bottom-right
-    alignment for cross-length decode shapes.
+    ``implementation``: 'auto' | 'xla' | 'flash' | 'ring' | 'ulysses'.
+    Arbitrary masks always take the XLA path (the flash kernel handles the
+    causal mask only); requesting 'flash' with a mask is an error rather
+    than a silent drop.  The flash kernel also requires s_q == s_k — its
+    causal mask is aligned to the main diagonal, whereas the XLA path uses
+    bottom-right alignment for cross-length decode shapes.
 
     'ring' runs sequence-parallel ring attention (parallel.ring) over
     ``mesh[ring_axis]`` — K/V shards rotate around the ICI ring while each
-    device attends its local query shard; requires ``mesh``.
+    device attends its local query shard; requires ``mesh``.  'ulysses'
+    is the all-to-all variant (parallel.ulysses): one a2a scatters heads /
+    gathers sequence, attention runs dense locally, a second a2a restores
+    the layout; requires ``mesh`` and heads divisible by the axis size.
     """
-    if implementation == "ring":
+    if implementation in ("ring", "ulysses"):
+        # Shared preconditions for the sequence-parallel strategies.
         if mask is not None:
             raise ValueError(
-                "ring attention supports the causal mask only; pass "
-                "implementation='xla' for arbitrary masks"
+                f"{implementation} attention supports the causal mask only; "
+                "pass implementation='xla' for arbitrary masks"
             )
         if mesh is None or ring_axis not in mesh.axis_names:
             raise ValueError(
-                "implementation='ring' needs a mesh with a live "
-                f"'{ring_axis}' axis (got mesh={mesh})"
+                f"implementation='{implementation}' needs a mesh with a "
+                f"live '{ring_axis}' axis (got mesh={mesh})"
             )
-        from ml_trainer_tpu.parallel.ring import ring_attention
-
-        return ring_attention(
+        if implementation == "ring":
+            from ml_trainer_tpu.parallel.ring import ring_attention as sp_fn
+        else:
+            from ml_trainer_tpu.parallel.ulysses import (
+                ulysses_attention as sp_fn,
+            )
+        return sp_fn(
             q, k, v, mesh, axis_name=ring_axis, causal=causal, scale=scale
         )
     if implementation == "flash":

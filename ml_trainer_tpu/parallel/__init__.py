@@ -31,6 +31,7 @@ from ml_trainer_tpu.parallel import collectives
 from ml_trainer_tpu.parallel.desync import check_desync, param_fingerprint
 from ml_trainer_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
 from ml_trainer_tpu.parallel.ring import ring_attention
+from ml_trainer_tpu.parallel.ulysses import ulysses_attention
 from ml_trainer_tpu.parallel.tp_rules import (
     FSDP_RULES,
     TRANSFORMER_TP_RULES,
@@ -43,6 +44,7 @@ __all__ = [
     "pipeline_apply",
     "stack_stage_params",
     "ring_attention",
+    "ulysses_attention",
     "FSDP_RULES",
     "TRANSFORMER_TP_RULES",
     "rules_for",

@@ -245,3 +245,54 @@ def test_zero1_opt_state_sharding_matches_replicated(tmp_path):
         jax.tree.leaves(t_rep.state.params), jax.tree.leaves(t_z1.state.params)
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ulysses_attention_matches_full(causal):
+    """Ulysses (a2a head-scatter) over an 8-way sequence shard == full
+    attention; the 8-way axis divides H=8."""
+    from ml_trainer_tpu.parallel import ulysses_attention
+
+    mesh = create_mesh({"sequence": 8})
+    rng = np.random.default_rng(0)
+    shape = (2, 8, 64, 16)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=shape), dtype=jnp.float32) for _ in range(3)
+    )
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_ulysses_under_jit_with_sharded_inputs_and_grad():
+    from ml_trainer_tpu.parallel import ulysses_attention
+
+    mesh = create_mesh({"sequence": 8})
+    rng = np.random.default_rng(1)
+    shape = (1, 8, 128, 16)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=shape), dtype=jnp.float32) for _ in range(3)
+    )
+    seq_sharding = jax.sharding.NamedSharding(mesh, P(None, None, "sequence", None))
+    qs, ks, vs = (jax.device_put(t, seq_sharding) for t in (q, k, v))
+
+    def loss_u(a, b, c):
+        return ulysses_attention(a, b, c, mesh, causal=True).sum()
+
+    def loss_ref(a, b, c):
+        return dot_product_attention(a, b, c, causal=True).sum()
+
+    gu = jax.jit(jax.grad(loss_u, argnums=(0, 1, 2)))(qs, ks, vs)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gu, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ulysses_head_divisibility_error():
+    from ml_trainer_tpu.parallel import ulysses_attention
+
+    mesh = create_mesh({"sequence": 8})
+    q = jnp.zeros((1, 6, 64, 16))
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, q, q, mesh)
