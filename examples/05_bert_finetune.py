@@ -1,0 +1,82 @@
+"""BERT sequence-classification fine-tune — the SST-2 north-star config
+(BASELINE.json configs[2]: BERT-base SST-2 fine-tune).
+
+Uses real GLUE SST-2 TSVs when present (DATA_DIR/train.tsv + dev.tsv),
+otherwise a tiny synthetic sentiment set through the same tokenize →
+TokenizedDataset → Trainer path (this environment has no egress, so the
+offline hash tokenizer stands in for a downloaded vocab).
+
+    python examples/05_bert_finetune.py                       # tiny, smoke
+    MODEL=bert_base DATA_DIR=data/sst2 EPOCHS=3 BATCH=32 \
+        python examples/05_bert_finetune.py                   # the real one
+"""
+
+import os
+
+from ml_trainer_tpu import Trainer
+from ml_trainer_tpu.data.text import TokenizedDataset, load_sst2_tsv
+from ml_trainer_tpu.models import get_model
+
+MODEL = os.environ.get("MODEL", "bert_tiny")
+DATA_DIR = os.environ.get("DATA_DIR", "data/sst2")
+MODEL_DIR = os.environ.get("MODEL_DIR", "model_output_bert")
+MAX_LEN = int(os.environ.get("MAX_LEN", "64"))
+
+SYNTH = [
+    ("a joyous, generous film that deserves every award", 1),
+    ("warm and funny from the first scene to the last", 1),
+    ("an absolute delight, sharp writing and great heart", 1),
+    ("the best surprise of the year, simply wonderful", 1),
+    ("tedious, joyless and far too long", 0),
+    ("a dull mess with nothing to say", 0),
+    ("painfully bad acting sinks every scene", 0),
+    ("a waste of a talented cast, avoid it", 0),
+] * 16
+
+
+def build_datasets(vocab_size):
+    try:
+        return (
+            load_sst2_tsv(os.path.join(DATA_DIR, "train.tsv"),
+                          max_len=MAX_LEN, vocab_size=vocab_size),
+            load_sst2_tsv(os.path.join(DATA_DIR, "dev.tsv"),
+                          max_len=MAX_LEN, vocab_size=vocab_size),
+        )
+    except (FileNotFoundError, OSError):
+        print("SST-2 TSVs not on disk; using the synthetic sentiment set")
+        texts, labels = zip(*SYNTH)
+        n = len(texts) * 3 // 4
+        mk = lambda t, l: TokenizedDataset.from_texts(  # noqa: E731
+            t, l, max_len=MAX_LEN, vocab_size=vocab_size
+        )
+        return mk(texts[:n], labels[:n]), mk(texts[n:], labels[n:])
+
+
+def main():
+    model_kw = {"num_classes": 2}
+    vocab_size = 30522
+    if MODEL == "bert_tiny":
+        vocab_size = 2048
+        model_kw.update(vocab_size=vocab_size, max_len=MAX_LEN)
+    datasets = build_datasets(vocab_size)
+    trainer = Trainer(
+        get_model(MODEL, **model_kw),
+        datasets=datasets,
+        epochs=int(os.environ.get("EPOCHS", "3")),
+        batch_size=int(os.environ.get("BATCH", "16")),
+        save_history=True,
+        optimizer="adamw",
+        lr=float(os.environ.get("LR", "2e-4")),
+        weight_decay=0.01,
+        criterion="cross_entropy",
+        metric="accuracy",
+        pred_function="softmax",
+        model_dir=MODEL_DIR,
+    )
+    trainer.fit()
+    print({k: (v[-1] if isinstance(v, list) else v)
+           for k, v in trainer.history.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -47,7 +47,27 @@ def build_datasets(args):
 
 def main(args) -> None:
     datasets = build_datasets(args)
-    model = get_model(args.model)
+    model_kw = {}
+    if args.dtype:
+        import jax.numpy as jnp
+
+        model_kw["dtype"] = {
+            "float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "bf16": jnp.bfloat16, "f32": jnp.float32,
+        }[args.dtype]
+    if args.remat:
+        model_kw["remat"] = True
+    if model_kw:
+        try:
+            model = get_model(args.model, **model_kw)
+        except TypeError as e:
+            raise SystemExit(
+                f"model {args.model!r} does not accept {sorted(model_kw)} "
+                f"(--dtype applies to the transformer/resnet families, "
+                f"--remat to the transformer families): {e}"
+            )
+    else:
+        model = get_model(args.model)
     config = {
         "seed": args.seed,
         "scheduler": args.scheduler,
@@ -122,6 +142,13 @@ def parse_args(argv=None):
                         help="use deterministic synthetic CIFAR-10 data")
     parser.add_argument("--synthetic_train_size", type=int, default=2048)
     parser.add_argument("--synthetic_val_size", type=int, default=512)
+    parser.add_argument("--dtype", type=str, default=None,
+                        choices=["float32", "bfloat16", "bf16", "f32"],
+                        help="model compute dtype (params stay f32); "
+                             "bfloat16 is the MXU-native choice")
+    parser.add_argument("--remat", action="store_true",
+                        help="jax.checkpoint per transformer block "
+                             "(activation memory O(depth) -> O(1) layers)")
     parser.add_argument("--profile", type=str, default=None,
                         help="directory for a jax.profiler trace of the "
                              "whole fit (TensorBoard-loadable)")
