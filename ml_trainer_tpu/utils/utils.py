@@ -55,10 +55,11 @@ def load_model(model: Any, PATH: str) -> LoadedModel:
     return LoadedModel(model, variables)
 
 
-def plot_history(history: dict) -> None:
+def plot_history(history: dict, show: bool = True):
     """Train-vs-validation curves (ref: src/utils/utils.py:31-68): two panels
     (loss + metric) when a metric was tracked, one otherwise; x-ticks thinned
-    past 25 epochs."""
+    past 25 epochs.  Returns the figure; ``show=False`` skips ``plt.show()``
+    (headless use/tests)."""
     from matplotlib import pyplot as plt
 
     x = history["epochs"]
@@ -88,13 +89,19 @@ def plot_history(history: dict) -> None:
             ax.legend()
         ax_loss.set_xlabel("Epochs")
     else:
-        plt.subplots(figsize=(10, 5))
-        plt.plot(x, history["train_loss"], c="C0", label="train")
-        plt.plot(x, history["val_loss"], c="C1", label="validation")
-        plt.xticks(x, rotation=45)
-        plt.xlabel("Epochs")
-        plt.ylabel("Loss")
-        plt.title("Training Loss vs. Validation Loss")
-        plt.legend()
+        fig, ax = plt.subplots(figsize=(10, 5))
+        ax.plot(x, history["train_loss"], c="C0", label="train")
+        ax.plot(x, history["val_loss"], c="C1", label="validation")
+        thin_ticks(ax)
+        ax.tick_params(axis="x", rotation=45)
+        ax.set_xlabel("Epochs")
+        ax.set_ylabel("Loss")
+        ax.set_title("Training Loss vs. Validation Loss")
+        ax.legend()
     plt.tight_layout()
-    plt.show()
+    if show:
+        # Render once and return None — returning the figure too would make
+        # a notebook cell ending in plot_history(...) display it twice.
+        plt.show()
+        return None
+    return fig

@@ -1,0 +1,75 @@
+"""Smoke tests for the example scripts — the executable form of the
+reference's notebook flows (SURVEY.md §4: notebooks are its de-facto
+integration tests; here the scripts run under pytest on the CPU mesh)."""
+
+import os
+import runpy
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def run_example(name, monkeypatch, tmp_path, env):
+    import sys
+
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    monkeypatch.chdir(tmp_path)
+    # The scripts read sys.argv (03 takes an optional checkpoint path);
+    # pytest's own argv must not leak into them.
+    monkeypatch.setattr(sys, "argv", [name])
+    runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")
+
+
+def test_example_01_then_03_flow(monkeypatch, tmp_path):
+    """01 (train→save→load→test) then 03 (inference-only on 01's model) —
+    the reference's 01→03 notebook chain."""
+    run_example("01_local_training.py", monkeypatch, tmp_path,
+                {"MODEL_DIR": str(tmp_path / "m")})
+    assert (tmp_path / "m" / "history.pkl").exists()
+    run_example("03_testing.py", monkeypatch, tmp_path,
+                {"MODEL_DIR": str(tmp_path / "m")})
+
+
+def test_example_04_gpt2_pretrain(monkeypatch, tmp_path):
+    run_example("04_gpt2_pretrain.py", monkeypatch, tmp_path, {
+        "MODEL_DIR": str(tmp_path / "g"), "EPOCHS": "1",
+        "SYNTH_SIZE": "64", "BATCH": "8", "SEQ_LEN": "32",
+        "ACCUM": "2", "K": "2", "REMAT": "1",
+    })
+    assert (tmp_path / "g" / "history.pkl").exists()
+
+
+def test_example_05_bert_finetune(monkeypatch, tmp_path):
+    run_example("05_bert_finetune.py", monkeypatch, tmp_path, {
+        "MODEL_DIR": str(tmp_path / "b"), "EPOCHS": "1", "BATCH": "16",
+        "MAX_LEN": "32",
+    })
+    assert (tmp_path / "b" / "history.pkl").exists()
+
+
+def test_plot_history_two_and_one_panel(tmp_path):
+    """plot_history parity shapes (ref: src/utils/utils.py:31-68):
+    2-panel with a metric, 1-panel without, tick thinning past 25."""
+    from ml_trainer_tpu.utils.utils import plot_history
+
+    n = 30  # past the 25-epoch tick-thinning threshold
+    h2 = {
+        "epochs": list(range(1, n + 1)),
+        "train_loss": list(np.linspace(2, 1, n)),
+        "val_loss": list(np.linspace(2.1, 1.2, n)),
+        "train_metric": list(np.linspace(0.3, 0.8, n)),
+        "val_metric": list(np.linspace(0.25, 0.75, n)),
+        "metric_type": "accuracy",
+    }
+    fig = plot_history(h2, show=False)
+    assert fig is not None and len(fig.axes) == 2
+    h1 = dict(h2, train_metric=[], val_metric=[], metric_type=None)
+    fig = plot_history(h1, show=False)
+    assert fig is not None and len(fig.axes) == 1
