@@ -89,6 +89,8 @@ def main(args) -> None:
         is_parallel=args.is_parallel,
         save_history=True,
         steps_per_execution=args.steps_per_execution,
+        grad_clip_norm=args.grad_clip_norm,
+        ema_decay=args.ema_decay,
         **config,
     )
     if args.profile:
@@ -156,6 +158,12 @@ def parse_args(argv=None):
                         help="optimizer steps per device dispatch "
                              "(lax.scan inside one compiled program; "
                              "trajectory identical, dispatch amortized)")
+    parser.add_argument("--grad_clip_norm", type=float, default=None,
+                        help="clip gradients to this global L2 norm "
+                             "before the optimizer update")
+    parser.add_argument("--ema_decay", type=float, default=None,
+                        help="keep an exponential moving average of the "
+                             "params; eval/save then use the EMA weights")
     # SageMaker-compatible env-backed paths (ref: main.py:80-83), with sane
     # defaults when the env vars are absent.
     parser.add_argument("--model_dir", type=str,

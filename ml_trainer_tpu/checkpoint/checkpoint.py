@@ -28,6 +28,7 @@ remain readable.
 
 from __future__ import annotations
 
+import copy
 import json
 import os
 import pickle
@@ -110,6 +111,9 @@ def _write_checkpoint_dir(
         if isinstance(leaf, dict):  # empty container leaf
             leaves.append({"path": list(path), "empty": True})
             continue
+        if leaf is None:  # e.g. TrainState.ema_params with EMA disabled
+            leaves.append({"path": list(path), "none": True})
+            continue
         arr = np.asarray(jax.device_get(leaf))
         fname = f"leaf_{i:05d}.npy"
         np.save(os.path.join(tmp_dir, fname), arr, allow_pickle=False)
@@ -168,8 +172,6 @@ def save_checkpoint(
     the disk writes run on the background writer thread; call
     ``wait_for_checkpoints()`` (the trainer does at fit-end) to surface
     errors."""
-    import copy
-
     os.makedirs(ckpt_dir, exist_ok=True)
     state_dict = fetch_to_host(serialization.to_state_dict(state))
     # Deep-copy on the caller's thread: the trainer hands us its LIVE
@@ -221,6 +223,47 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     return os.path.join(ckpt_dir, found[-1][1])
 
 
+def _reconcile_ema(state_template: Any, saved: Any) -> Any:
+    """Make checkpoints portable across the ``ema_decay`` setting (and
+    across its addition to TrainState).  Missing/None EMA + EMA-enabled
+    template → seed the EMA from the saved params; EMA in the checkpoint +
+    EMA-disabled template → drop it; pre-EMA checkpoints → inject None."""
+    if not isinstance(saved, dict):
+        return saved
+    tpl = serialization.to_state_dict(state_template)
+    if not (isinstance(tpl, dict) and "ema_params" in tpl):
+        return saved
+    want_ema = tpl["ema_params"] is not None
+    have = saved.get("ema_params")
+    if want_ema and have is None:
+        # EMA turned on for (or added to) this run: start it at the
+        # restored params, exactly how a fresh Trainer seeds it.  Aliasing
+        # the host arrays is fine — restore only reads them, and
+        # device_put gives each leaf its own device buffer.
+        saved = dict(saved)
+        saved["ema_params"] = saved.get("params")
+    elif not want_ema:
+        saved = dict(saved)
+        saved["ema_params"] = None
+    return saved
+
+
+def _from_state_dict_compat(state_template: Any, saved: Any) -> Any:
+    """``from_state_dict`` with a fallback for checkpoints written before the
+    trainer wrapped every optimizer in ``chain(clip-or-identity, inner)``:
+    their opt_state lacks the outer chain level, so re-nest it under the
+    template's ``{'0': {}, '1': inner}`` shape and retry."""
+    saved = _reconcile_ema(state_template, saved)
+    try:
+        return serialization.from_state_dict(state_template, saved)
+    except (ValueError, KeyError, AttributeError):
+        if not (isinstance(saved, dict) and "opt_state" in saved):
+            raise
+        wrapped = dict(saved)
+        wrapped["opt_state"] = {"0": {}, "1": saved["opt_state"]}
+        return serialization.from_state_dict(state_template, wrapped)
+
+
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:
     """Restore (state, history, epoch); the template supplies pytree
     structure (the trainer always has one before restoring)."""
@@ -232,16 +275,18 @@ def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:
                 tuple(leaf["path"]),
                 {}
                 if leaf.get("empty")
+                else None
+                if leaf.get("none")
                 else np.load(
                     os.path.join(path, leaf["file"]), allow_pickle=False
                 ),
             )
             for leaf in manifest["leaves"]
         ]
-        state = serialization.from_state_dict(state_template, _unflatten(pairs))
+        state = _from_state_dict_compat(state_template, _unflatten(pairs))
         return state, manifest["history"], manifest["epoch"]
     # Legacy v1 monolithic pickle (round-1 checkpoints).
     with open(path, "rb") as fp:
         payload = pickle.load(fp)
-    state = serialization.from_state_dict(state_template, payload["state"])
+    state = _from_state_dict_compat(state_template, payload["state"])
     return state, payload["history"], payload["epoch"]

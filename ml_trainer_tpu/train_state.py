@@ -22,3 +22,6 @@ class TrainState(struct.PyTreeNode):
     batch_stats: Any  # {} for models without BatchNorm
     rng: jnp.ndarray  # functional PRNG key (the torch.manual_seed analog,
     #                   ref: src/trainer.py:47, but split per step)
+    ema_params: Any = None  # EMA of params when Trainer(ema_decay=...) is
+    #                         set; None (an empty pytree) otherwise, so
+    #                         checkpoints without EMA keep the same leaves

@@ -141,6 +141,8 @@ class Trainer:
         loader: str = "auto",
         steps_per_execution: int = 1,
         shard_opt_state: bool = False,
+        grad_clip_norm: Optional[float] = None,
+        ema_decay: Optional[float] = None,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -174,7 +176,20 @@ class Trainer:
         moments are partitioned over the ``data`` mesh axis (a sharding
         annotation; XLA inserts the implied collectives), cutting optimizer
         memory per device by the data-parallel degree with an identical
-        update sequence."""
+        update sequence.
+
+        ``grad_clip_norm``: clip gradients to this global L2 norm before
+        the optimizer update (``optax.clip_by_global_norm`` chained in
+        front of the optimizer — with grad accumulation the clip applies
+        to the averaged global-batch gradient, matching torch's
+        ``clip_grad_norm_``-before-``step()`` placement).
+
+        ``ema_decay``: maintain an exponential moving average of the
+        parameters on-device (``ema = d*ema + (1-d)*params`` each step).
+        When set, validation, ``test()`` and ``save_model`` use the EMA
+        weights (the standard ViT/ImageNet recipe); the raw weights keep
+        training and are what checkpoints resume from (both live in the
+        checkpointed TrainState)."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -224,6 +239,16 @@ class Trainer:
             )
         self.steps_per_execution = int(steps_per_execution)
         self._shard_opt_state = bool(shard_opt_state)
+        if grad_clip_norm is not None and grad_clip_norm <= 0:
+            raise ValueError(
+                f"grad_clip_norm must be positive, got {grad_clip_norm}"
+            )
+        self.grad_clip_norm = grad_clip_norm
+        if ema_decay is not None and not (0.0 < ema_decay < 1.0):
+            raise ValueError(
+                f"ema_decay must be in (0, 1), got {ema_decay}"
+            )
+        self.ema_decay = ema_decay
         if self.is_parallel:
             # Rendezvous — the init_process_group analog (ref: src/trainer.py:59).
             initialize_distributed(cfg.backend)
@@ -399,6 +424,16 @@ class Trainer:
         self.tx = get_optimizer(
             cfg.optimizer, self.lr_schedule, cfg.momentum, cfg.weight_decay
         )
+        # Always chain (both clip and identity carry EmptyState), so the
+        # opt_state pytree structure — and therefore checkpoints — do not
+        # depend on whether clipping is on: the flag can toggle across a
+        # resume.
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(self.grad_clip_norm)
+            if self.grad_clip_norm is not None
+            else optax.identity(),
+            self.tx,
+        )
         if cfg.scheduler == "ReduceLROnPlateau":
             self._plateau = PlateauController(cfg.lr)
 
@@ -443,12 +478,18 @@ class Trainer:
                 from ml_trainer_tpu.parallel import shard_opt_state as _shard_opt
 
                 opt_state = _shard_opt(opt_state, self.mesh)
+        # EMA weights start as a copy of the placed params (same shardings).
+        ema_params = (
+            jax.tree.map(jnp.copy, params) if self.ema_decay is not None
+            else None
+        )
         self.state = TrainState(
             step=jax.device_put(jnp.zeros((), jnp.int32), self._replicated),
             params=params,
             opt_state=opt_state,
             batch_stats=batch_stats,
             rng=jax.device_put(state_rng, self._replicated),
+            ema_params=ema_params,
         )
         self._state_shardings = jax.tree.map(lambda x: x.sharding, self.state)
         train_step = self._make_train_step()
@@ -481,6 +522,7 @@ class Trainer:
         criterion, metric_fn, tx = self.criterion, self.metric_fn, self.tx
         has_bs, model_apply = self._has_batch_stats, self._apply
         accum = self.grad_accum_steps
+        ema_decay = self.ema_decay
 
         def grads_for(params, batch_stats, x, y, dropout_rng):
             def loss_fn(params):
@@ -546,12 +588,20 @@ class Trainer:
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             updates = jax.tree.map(lambda u: u * lr_scale, updates)
             new_params = optax.apply_updates(state.params, updates)
+            new_ema = (
+                jax.tree.map(
+                    lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                    state.ema_params, new_params,
+                )
+                if ema_decay is not None else state.ema_params
+            )
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
                 opt_state=new_opt,
                 batch_stats=new_bs,
                 rng=rng,
+                ema_params=new_ema,
             )
             return new_state, loss, metric_val
 
@@ -585,8 +635,17 @@ class Trainer:
             eval_multi = jax.jit(eval_multi_fn)
         return jax.jit(eval_step), eval_multi
 
-    def _state_variables(self) -> dict:
-        variables = {"params": self.state.params}
+    def _state_variables(self, ema: Optional[bool] = None) -> dict:
+        """Inference-time variables.  With ``ema_decay`` set the EMA weights
+        are the model's public face (eval/test/save); pass ``ema=False`` for
+        the raw training weights."""
+        use_ema = self.ema_decay is not None if ema is None else ema
+        params = (
+            self.state.ema_params
+            if use_ema and self.state.ema_params is not None
+            else self.state.params
+        )
+        variables = {"params": params}
         if self._has_batch_stats:
             variables["batch_stats"] = self.state.batch_stats
         return variables

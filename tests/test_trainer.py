@@ -225,3 +225,158 @@ def test_steps_per_execution_ragged_batch_in_chunk_position(tmp_path):
     t.fit()
     assert len(t.history["train_loss"]) == 1
     assert np.isfinite(t.history["train_loss"][0])
+
+
+def test_grad_clip_norm_limits_update(tmp_path):
+    """With a tiny clip norm the SGD update must equal lr * clip * g/|g|;
+    verified against a manual computation on the first step."""
+    import jax.numpy as jnp
+
+    clip = 1e-3
+    trainer = make_trainer(
+        tmp_path, epochs=1, optimizer="sgd", momentum=0.0, lr=1.0,
+        grad_clip_norm=clip,
+    )
+    before = jax.tree.map(np.asarray, trainer.state.params)
+    x, y = next(iter(trainer.train_loader))
+    state, _, _ = trainer._train_step(
+        trainer.state, jnp.asarray(x), jnp.asarray(y),
+        jnp.asarray(1.0, jnp.float32),
+    )
+    after = jax.tree.map(np.asarray, state.params)
+    deltas = jax.tree.leaves(
+        jax.tree.map(lambda a, b: b - a, before, after)
+    )
+    global_norm = float(np.sqrt(sum((d ** 2).sum() for d in deltas)))
+    # lr=1, no momentum: |update| == min(|g|, clip) == clip for a fresh net.
+    assert global_norm <= clip * 1.01
+    assert global_norm >= clip * 0.5  # gradient was actually clipped, not ~0
+
+
+def test_grad_clip_invalid_raises(tmp_path):
+    with pytest.raises(ValueError):
+        make_trainer(tmp_path, grad_clip_norm=0.0)
+    with pytest.raises(ValueError):
+        make_trainer(tmp_path, ema_decay=1.0)
+
+
+def test_ema_tracks_params_and_drives_eval(tmp_path):
+    """EMA params follow ema = d*ema + (1-d)*p each step (manual recompute),
+    and _state_variables()/save_model expose the EMA weights."""
+    import jax.numpy as jnp
+
+    d = 0.9
+    trainer = make_trainer(
+        tmp_path, epochs=1, batch_size=32, optimizer="sgd", momentum=0.0,
+        lr=0.05, ema_decay=d,
+    )
+    ema = jax.tree.map(np.asarray, trainer.state.params)  # starts as copy
+    state = trainer.state
+    for i, (x, y) in enumerate(trainer.train_loader):
+        state, _, _ = trainer._train_step(
+            state, jnp.asarray(x), jnp.asarray(y),
+            jnp.asarray(1.0, jnp.float32),
+        )
+        new_params = jax.tree.map(np.asarray, state.params)
+        ema = jax.tree.map(lambda e, p: d * e + (1 - d) * p, ema, new_params)
+        if i == 2:
+            break
+    got = jax.tree.map(np.asarray, state.ema_params)
+    for a, b in zip(jax.tree.leaves(ema), jax.tree.leaves(got)):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+    # EMA != raw params after updates, and eval variables serve the EMA.
+    trainer.state = state
+    raw = trainer._state_variables(ema=False)["params"]
+    served = trainer._state_variables()["params"]
+    assert any(
+        not np.allclose(a, b)
+        for a, b in zip(jax.tree.leaves(raw), jax.tree.leaves(served))
+    )
+    for a, b in zip(jax.tree.leaves(served), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), b, rtol=1e-6)
+
+
+def test_ema_fit_and_resume_roundtrip(tmp_path):
+    """fit() with EMA runs end-to-end; the EMA tree survives checkpoint
+    resume (it lives inside TrainState)."""
+    trainer = make_trainer(tmp_path, epochs=2, ema_decay=0.99)
+    trainer.fit()
+    ema_after = jax.tree.map(np.asarray, trainer.state.ema_params)
+    resumed = make_trainer(tmp_path, epochs=2, ema_decay=0.99)
+    resumed.fit(resume=True)  # epochs done -> restores state, trains nothing
+    for a, b in zip(
+        jax.tree.leaves(ema_after),
+        jax.tree.leaves(jax.tree.map(np.asarray, resumed.state.ema_params)),
+    ):
+        np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+def test_ema_toggle_across_resume(tmp_path):
+    """Checkpoints stay resumable when ema_decay changes between runs:
+    off->on seeds the EMA from the restored params; on->off drops it."""
+    make_trainer(tmp_path, epochs=1).fit()  # checkpoint without EMA
+    on = make_trainer(tmp_path, epochs=2, ema_decay=0.99)
+    on.fit(resume=True)  # must not crash; EMA seeded from restored params
+    assert on.state.ema_params is not None
+    off = make_trainer(tmp_path, epochs=3)
+    off.fit(resume=True)  # EMA in checkpoint, disabled now -> dropped
+    assert off.state.ema_params is None
+
+
+def test_pre_ema_checkpoint_still_resumes(tmp_path):
+    """A checkpoint written before TrainState grew ema_params (manifest has
+    no such leaf) must restore into the new template."""
+    import json as _json
+
+    trainer = make_trainer(tmp_path, epochs=1)
+    trainer.fit()
+    ckpt_dir = os.path.join(str(tmp_path), "checkpoints")
+    name = sorted(os.listdir(ckpt_dir))[-1]
+    manifest_path = os.path.join(ckpt_dir, name, "manifest.json")
+    with open(manifest_path) as f:
+        manifest = _json.load(f)
+    pruned = [
+        leaf for leaf in manifest["leaves"]
+        if leaf["path"][0] != "ema_params"
+    ]
+    assert len(pruned) < len(manifest["leaves"])  # the field was recorded
+    manifest["leaves"] = pruned
+    with open(manifest_path, "w") as f:
+        _json.dump(manifest, f)
+    resumed = make_trainer(tmp_path, epochs=2)
+    resumed.fit(resume=True)  # old-format checkpoint restores cleanly
+    assert resumed.history["epochs"] == [1, 2]
+
+
+def test_grad_clip_toggle_across_resume(tmp_path):
+    """opt_state structure is clip-flag-independent (always-chained), so a
+    checkpoint saved without clipping resumes with it on, and vice versa."""
+    make_trainer(tmp_path, epochs=1).fit()
+    clipped = make_trainer(tmp_path, epochs=2, grad_clip_norm=1.0)
+    clipped.fit(resume=True)
+    assert clipped.history["epochs"] == [1, 2]
+    off = make_trainer(tmp_path, epochs=3)
+    off.fit(resume=True)
+    assert off.history["epochs"] == [1, 2, 3]
+
+
+def test_pre_chain_opt_state_checkpoint_restores(tmp_path):
+    """Checkpoints written before the always-chain wrapper (opt_state one
+    nesting level shallower) restore through the compat shim."""
+    from ml_trainer_tpu.checkpoint import checkpoint as ckpt_mod
+    from flax import serialization
+
+    trainer = make_trainer(tmp_path, epochs=1)
+    trainer.fit()
+    path = ckpt_mod.latest_checkpoint(
+        os.path.join(str(tmp_path), "checkpoints")
+    )
+    # Rewrite the checkpoint with the old (unchained) opt_state layout.
+    state_dict = serialization.to_state_dict(
+        ckpt_mod.fetch_to_host(trainer.state)
+    )
+    state_dict["opt_state"] = state_dict["opt_state"]["1"]
+    ckpt_mod._write_checkpoint_dir(path, state_dict, trainer.history, 1)
+    resumed = make_trainer(tmp_path, epochs=2)
+    resumed.fit(resume=True)
+    assert resumed.history["epochs"] == [1, 2]
