@@ -26,11 +26,15 @@ from ml_trainer_tpu.ops.attention import dot_product_attention, flash_attention 
 
 
 def bench(fn, *args, iters=20):
-    jax.block_until_ready(fn(*args))  # compile + warm
+    from ml_trainer_tpu.utils.profiler import force
+
+    force(fn(*args))  # compile + warm (force: block_until_ready lies on
+    #                   the remote tunnel — see profiler.force docstring)
     t0 = time.perf_counter()
+    out = None
     for _ in range(iters):
         out = fn(*args)
-    jax.block_until_ready(out)
+    force(out)
     return (time.perf_counter() - t0) / iters
 
 
