@@ -143,6 +143,7 @@ class Trainer:
         shard_opt_state: bool = False,
         grad_clip_norm: Optional[float] = None,
         ema_decay: Optional[float] = None,
+        moe_aux_weight: float = 0.01,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -189,7 +190,14 @@ class Trainer:
         When set, validation, ``test()`` and ``save_model`` use the EMA
         weights (the standard ViT/ImageNet recipe); the raw weights keep
         training and are what checkpoints resume from (both live in the
-        checkpointed TrainState)."""
+        checkpointed TrainState).
+
+        ``moe_aux_weight``: coefficient on auxiliary losses the model sows
+        into the ``losses`` collection (the Switch-Transformer load-balance
+        loss from ``models.moe.MoEMLP``).  Captured inside the compiled
+        train step and added to the training loss, so top-1 routing is
+        actually pushed toward balanced expert assignment; dense models sow
+        nothing and pay nothing."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -249,6 +257,11 @@ class Trainer:
                 f"ema_decay must be in (0, 1), got {ema_decay}"
             )
         self.ema_decay = ema_decay
+        if moe_aux_weight < 0:
+            raise ValueError(
+                f"moe_aux_weight must be >= 0, got {moe_aux_weight}"
+            )
+        self.moe_aux_weight = float(moe_aux_weight)
         if self.is_parallel:
             # Rendezvous — the init_process_group analog (ref: src/trainer.py:59).
             initialize_distributed(cfg.backend)
@@ -400,8 +413,13 @@ class Trainer:
         if self._takes_train:
             kwargs["train"] = train
         if mutable:
+            if not isinstance(mutable, (list, tuple)):
+                raise TypeError(
+                    f"mutable must be False or a list of collection names, "
+                    f"got {mutable!r}"
+                )
             return self.model.apply(
-                variables, x, rngs=rngs, mutable=["batch_stats"], **kwargs
+                variables, x, rngs=rngs, mutable=list(mutable), **kwargs
             )
         return self.model.apply(variables, x, rngs=rngs, **kwargs)
 
@@ -416,6 +434,19 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         self._has_batch_stats = bool(batch_stats)
+        # Detect sown auxiliary losses (MoEMLP's load-balance term) with a
+        # shape-only trace of the TRAIN-mode forward — init() runs at
+        # train=False, which would miss losses gated on training (router
+        # z-loss variants).  The train step then captures and applies them.
+        probe_kwargs = {"train": True} if self._takes_train else {}
+        mut_shapes = jax.eval_shape(
+            lambda v, r: self.model.apply(
+                v, sample_x, rngs={"dropout": r}, mutable=["losses"],
+                **probe_kwargs,
+            )[1],
+            variables, dropout_rng,
+        )
+        self._has_aux_losses = bool(mut_shapes.get("losses"))
 
         self.steps_per_epoch = len(self.train_loader)
         self.lr_schedule = make_lr_schedule(
@@ -521,6 +552,8 @@ class Trainer:
     def _make_train_step(self):
         criterion, metric_fn, tx = self.criterion, self.metric_fn, self.tx
         has_bs, model_apply = self._has_batch_stats, self._apply
+        has_aux = getattr(self, "_has_aux_losses", False)
+        aux_weight = self.moe_aux_weight
         accum = self.grad_accum_steps
         ema_decay = self.ema_decay
 
@@ -529,17 +562,29 @@ class Trainer:
                 variables = {"params": params}
                 if has_bs:
                     variables["batch_stats"] = batch_stats
+                mutable_cols = (["batch_stats"] if has_bs else []) + (
+                    ["losses"] if has_aux else []
+                )
+                if mutable_cols:
                     out, mutated = model_apply(
                         variables, x, train=True,
-                        rngs={"dropout": dropout_rng}, mutable=True,
+                        rngs={"dropout": dropout_rng}, mutable=mutable_cols,
                     )
-                    new_bs = mutated["batch_stats"]
+                    new_bs = mutated.get("batch_stats", batch_stats)
                 else:
                     out = model_apply(
                         variables, x, train=True, rngs={"dropout": dropout_rng}
                     )
+                    mutated = {}
                     new_bs = batch_stats
-                return criterion(out, y), (out, new_bs)
+                loss = criterion(out, y)
+                if has_aux:
+                    # Sown auxiliary losses (e.g. MoE load-balance,
+                    # models/moe.py): summed over layers, scaled once.
+                    aux_terms = jax.tree.leaves(mutated.get("losses", {}))
+                    if aux_terms:
+                        loss = loss + aux_weight * sum(aux_terms)
+                return loss, (out, new_bs)
 
             (loss, (out, new_bs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True

@@ -130,3 +130,89 @@ def test_moe_trains_expert_parallel(tmp_path):
     assert wi.sharding.spec == P("expert", None, None)
     t.fit()
     assert np.isfinite(t.train_losses[0])
+
+
+def test_moe_aux_loss_applied_in_train_step(tmp_path):
+    """VERDICT r2 #3: the sown load-balance loss must be CONSUMED by the
+    train step, not just computed.  With a huge ``moe_aux_weight`` the
+    recorded training loss is dominated by the aux term (>= weight * 1.0,
+    the perfect-balance lower bound); with weight 0 it is ordinary
+    cross-entropy scale."""
+    ds = SyntheticTokens(size=16, seq_len=16, vocab_size=256, seed=0)
+
+    def run(weight):
+        t = Trainer(
+            get_model("gpt2_moe_tiny"), datasets=(ds, ds),
+            model_dir=str(tmp_path), epochs=1, batch_size=8,
+            metric=None, optimizer="sgd", lr=0.0,
+            moe_aux_weight=weight,
+        )
+        assert t._has_aux_losses
+        t.fit()
+        return t.train_losses[0]
+
+    base = run(0.0)
+    boosted = run(1000.0)
+    # gpt2_moe_tiny has MoE in both of its two blocks; each layer's aux
+    # is >= 1.0 by Cauchy-Schwarz, so the boosted loss must sit >= 2000
+    # above the plain loss (assert with slack).
+    assert boosted - base >= 1800.0
+
+
+def test_moe_aux_loss_rebalances_collapsed_router():
+    """Behavioral check: start from a router biased hard onto expert 0 and
+    train on random data.  With the aux loss the expert-assignment entropy
+    recovers toward log(E); without it the collapse persists."""
+    import optax
+
+    e, m, hidden, tokens = 4, 16, 32, 256
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(1, tokens, m)), jnp.float32)
+    target = jnp.asarray(rng.normal(size=(1, tokens, m)), jnp.float32)
+    moe = MoEMLP(num_experts=e, hidden_dim=hidden, capacity_factor=2.0)
+    variables = moe.init({"params": jax.random.PRNGKey(0)}, x)
+    params = variables["params"]
+    # Force the collapse: bias the router onto expert 0.
+    params = jax.tree.map(lambda p: p, params)
+    params["router"]["bias"] = params["router"]["bias"].at[0].add(4.0)
+
+    def entropy_of(params):
+        logits = x.reshape(-1, m) @ params["router"]["kernel"] + params[
+            "router"
+        ]["bias"]
+        frac = np.bincount(
+            np.asarray(jnp.argmax(logits, axis=-1)), minlength=e
+        ) / float(tokens)
+        nz = frac[frac > 0]
+        return float(-(nz * np.log(nz)).sum())
+
+    def train(params, aux_weight, steps=150):
+        tx = optax.adam(0.01)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state):
+            def loss_fn(p):
+                out, mut = moe.apply(
+                    {"params": p}, x, mutable=["losses"]
+                )
+                mse = jnp.mean((out - target) ** 2)
+                aux = sum(jax.tree.leaves(mut["losses"]))
+                return mse + aux_weight * aux
+
+            grads = jax.grad(loss_fn)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        for _ in range(steps):
+            params, opt_state = step(params, opt_state)
+        return params
+
+    assert entropy_of(params) < 0.3  # collapsed at start
+    with_aux = train(params, 0.02, steps=300)
+    without_aux = train(params, 0.0, steps=300)
+    ent_with, ent_without = entropy_of(with_aux), entropy_of(without_aux)
+    # log(4) = 1.386; the aux loss must restore most of it, the bare MSE
+    # objective must not.
+    assert ent_with > 1.0, ent_with
+    assert ent_with > ent_without + 0.5, (ent_with, ent_without)
