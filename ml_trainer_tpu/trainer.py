@@ -437,12 +437,14 @@ class Trainer:
         # Detect sown auxiliary losses (MoEMLP's load-balance term) with a
         # shape-only trace of the TRAIN-mode forward — init() runs at
         # train=False, which would miss losses gated on training (router
-        # z-loss variants).  The train step then captures and applies them.
-        probe_kwargs = {"train": True} if self._takes_train else {}
+        # z-loss variants).  batch_stats must stay mutable during the probe
+        # or BatchNorm models would fail the trace.  The train step then
+        # captures and applies whatever the probe finds.
+        probe_cols = ["losses"] + (["batch_stats"] if batch_stats else [])
         mut_shapes = jax.eval_shape(
-            lambda v, r: self.model.apply(
-                v, sample_x, rngs={"dropout": r}, mutable=["losses"],
-                **probe_kwargs,
+            lambda v, r: self._apply(
+                v, sample_x, train=True, rngs={"dropout": r},
+                mutable=probe_cols,
             )[1],
             variables, dropout_rng,
         )

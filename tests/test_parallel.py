@@ -296,3 +296,37 @@ def test_ulysses_head_divisibility_error():
     q = jnp.zeros((1, 6, 64, 16))
     with pytest.raises(ValueError, match="heads"):
         ulysses_attention(q, q, q, mesh)
+
+
+def test_ulysses_sequence_parallel_training_matches_dp(tmp_path):
+    """VERDICT r2 #7: Ulysses integrated end-to-end, parity with the ring
+    integration — gpt2_tiny with ``attention_impl='ulysses'`` (heads
+    scattered / sequence gathered by all-to-all inside each block) trains
+    through the full Trainer on a {data:2, sequence:4} mesh and matches
+    the pure-DP trajectory."""
+    ds = SyntheticTokens(size=32, seq_len=64, vocab_size=1024, seed=0)
+    common = dict(
+        epochs=2, batch_size=8, seed=3, lr=0.01, optimizer="adamw",
+        metric=None,
+    )
+    t_dp = Trainer(
+        get_model("gpt2_tiny"), datasets=(ds, ds),
+        model_dir=str(tmp_path / "dp"), is_parallel=True, backend="cpu",
+        **common,
+    )
+    t_dp.fit()
+
+    mesh = create_mesh({"data": 2, "sequence": 4})
+    t_sp = Trainer(
+        get_model("gpt2_tiny", attention_impl="ulysses", mesh=mesh),
+        datasets=(ds, ds),
+        model_dir=str(tmp_path / "sp"), is_parallel=True, backend="cpu",
+        mesh_shape={"data": 2, "sequence": 4},
+        **common,
+    )
+    assert t_sp._batch_sharding.spec == P(("data",), "sequence")
+    t_sp.fit()
+    np.testing.assert_allclose(
+        t_dp.train_losses, t_sp.train_losses, rtol=1e-3
+    )
+    np.testing.assert_allclose(t_dp.val_losses, t_sp.val_losses, rtol=1e-3)

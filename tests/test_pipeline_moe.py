@@ -173,7 +173,6 @@ def test_moe_aux_loss_rebalances_collapsed_router():
     variables = moe.init({"params": jax.random.PRNGKey(0)}, x)
     params = variables["params"]
     # Force the collapse: bias the router onto expert 0.
-    params = jax.tree.map(lambda p: p, params)
     params["router"]["bias"] = params["router"]["bias"].at[0].add(4.0)
 
     def entropy_of(params):

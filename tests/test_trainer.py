@@ -11,6 +11,7 @@ import pytest
 
 from ml_trainer_tpu import Trainer, MLModel, Loader, load_history, load_model
 from ml_trainer_tpu.data import SyntheticCIFAR10
+from ml_trainer_tpu.models import get_model
 from ml_trainer_tpu.utils.functions import custom_pre_process_function
 
 
@@ -380,3 +381,22 @@ def test_pre_chain_opt_state_checkpoint_restores(tmp_path):
     resumed = make_trainer(tmp_path, epochs=2)
     resumed.fit(resume=True)
     assert resumed.history["epochs"] == [1, 2]
+
+
+def test_batchnorm_model_trains(tmp_path):
+    """Regression: Trainer construction must tolerate batch_stats models in
+    the aux-loss probe (the train-mode trace keeps batch_stats mutable) and
+    running statistics must actually update over an epoch."""
+    ds = SyntheticCIFAR10(size=16, seed=0)
+    t = Trainer(
+        get_model("resnet18"), datasets=(ds, ds), epochs=1, batch_size=8,
+        model_dir=str(tmp_path), metric="accuracy",
+    )
+    assert t._has_batch_stats and not t._has_aux_losses
+    # Copy to host before fit(): the donated train step consumes the
+    # original device buffers.
+    before = np.asarray(jax.tree.leaves(t.state.batch_stats)[0])
+    t.fit()
+    after = np.asarray(jax.tree.leaves(t.state.batch_stats)[0])
+    assert np.isfinite(t.train_losses[0])
+    assert not np.allclose(before, after)
