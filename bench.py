@@ -209,9 +209,28 @@ def _compiled_flops(compiled) -> float | None:
         return None
 
 
-def bench_extended():
-    """North-star models: one full train step (bf16 compute, f32 params),
-    steady-state steps/sec + MFU (achieved FLOPs / chip peak)."""
+# One row per model: (batch shape, task kind, constructor kwargs-builder).
+# kwargs are built lazily (jnp.bfloat16 needs jax at call time, and keeping
+# everything in one table means a new model cannot be half-registered).
+EXTENDED_CONFIGS = {
+    "resnet50": ((32, 224, 224, 3), "image", lambda: dict(dtype=jnp.bfloat16)),
+    "vit_b16": ((32, 224, 224, 3), "image",
+                lambda: dict(num_classes=1000, dtype=jnp.bfloat16)),
+    "bert_base": ((32, 128), "tokens",
+                  lambda: dict(num_classes=2, dtype=jnp.bfloat16)),
+    "gpt2": ((8, 1024), "lm", lambda: dict(dtype=jnp.bfloat16)),
+}
+
+
+def bench_one_model(name: str) -> dict:
+    """One north-star model: one full train step (bf16 compute, f32
+    params), steady-state samples/sec + MFU (achieved FLOPs / chip peak).
+
+    Everything device-touching is jitted: flax ``init`` executes EAGERLY
+    by default — per-op dispatch, which over the remote TPU tunnel means
+    one round trip per op and took ResNet-50's init past 45 minutes in
+    round 3's first attempt.  ``jax.jit(model.init)`` makes it one
+    compile + one execution."""
     import optax
 
     from ml_trainer_tpu.models import get_model
@@ -219,115 +238,168 @@ def bench_extended():
     from ml_trainer_tpu.train_state import TrainState
 
     bf16 = jnp.bfloat16
-    configs = [
-        ("resnet50", dict(dtype=bf16), (32, 224, 224, 3), "image", bf16),
-        ("vit_b16", dict(num_classes=1000, dtype=bf16), (32, 224, 224, 3), "image", bf16),
-        ("bert_base", dict(num_classes=2, dtype=bf16), (32, 128), "tokens", None),
-        ("gpt2", dict(dtype=bf16), (8, 1024), "lm", None),
-    ]
-    import os
+    shape, kind, make_kw = EXTENDED_CONFIGS[name]
+    model = get_model(name, **make_kw())
+    rng = np.random.default_rng(0)
+    if kind == "image":
+        x = jnp.asarray(rng.normal(size=shape), bf16)
+        y = jnp.asarray(rng.integers(0, 10, shape[0]), jnp.int32)
+    else:
+        x = jnp.asarray(rng.integers(0, 1000, shape), jnp.int32)
+        y = (
+            jnp.roll(x, -1, axis=1)
+            if kind == "lm"
+            else jnp.asarray(rng.integers(0, 2, shape[0]), jnp.int32)
+        )
 
-    # Stay under the process watchdog (default 1500s) so the budget-skip
-    # path can actually fire and the headline metric still runs after.
+    t_c = time.time()
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, x, train=False
+    )
+    print(f"# {name}: init in {time.time() - t_c:.0f}s",
+          file=sys.stderr, flush=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    tx = get_optimizer("adamw", 1e-4)
+    criterion = get_criterion("cross_entropy")
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=jax.jit(tx.init)(params), batch_stats=batch_stats,
+        rng=jax.random.PRNGKey(1),
+    )
+    has_bs = bool(batch_stats)
+
+    @jax.jit
+    def step(state, x, y):
+        def loss_fn(p):
+            if has_bs:
+                out, mut = model.apply(
+                    {"params": p, "batch_stats": state.batch_stats},
+                    x, train=True, mutable=["batch_stats"],
+                )
+                return criterion(out, y), mut["batch_stats"]
+            out = model.apply({"params": p}, x, train=True)
+            return criterion(out, y), state.batch_stats
+
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, opt_state = tx.update(
+            grads, state.opt_state, state.params
+        )
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=optax.apply_updates(state.params, updates),
+                opt_state=opt_state,
+                batch_stats=new_bs,
+            ),
+            loss,
+        )
+
+    # Compile ONCE; the same executable feeds the FLOPs analysis and the
+    # timing loop (a second jit-path compile would double the
+    # remote-compile tunnel cost).
+    t_c = time.time()
+    compiled = step.lower(state, x, y).compile()
+    print(f"# {name}: compiled in {time.time() - t_c:.0f}s",
+          file=sys.stderr, flush=True)
+    flops = _compiled_flops(compiled)
+    rate, _ = _steady_state_rate(
+        compiled, state, [(x, y)], warmup=3, iters=20
+    )
+    # MFU only means something against the real chip's peak.
+    on_tpu = jax.default_backend() == "tpu"
+    mfu = rate * flops / _chip_peak_flops() if (flops and on_tpu) else None
+    return {
+        "model": name, "batch_shape": list(shape),
+        "samples_per_sec": round(rate * shape[0], 1),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+    }
+
+
+def bench_extended():
+    """North-star table, one model per SUBPROCESS so a tunnel hang in any
+    single model costs its per-model timeout, not the whole table (round
+    3's first attempt lost all four models to one hung init)."""
+    import os
+    import subprocess
+
     watchdog = float(os.environ.get("BENCH_WATCHDOG_SECS", "1500"))
     budget = float(
         os.environ.get("EXTENDED_BUDGET_SECS", str(0.6 * watchdog))
     )
+    per_model = float(os.environ.get("EXTENDED_PER_MODEL_SECS", "600"))
     t_start = time.time()
-    rows = []
-    for name, kw, shape, kind, in_dtype in configs:
-        if time.time() - t_start > budget:
-            rows.append(
-                (name, shape,
-                 f"SKIPPED: extended time budget ({budget:.0f}s) exhausted "
-                 "(remote-compile tunnel)", None)
-            )
-            continue
-        try:
-            model = get_model(name, **kw)
-            rng = np.random.default_rng(0)
-            if kind == "image":
-                x = jnp.asarray(rng.normal(size=shape), dtype=in_dtype or jnp.float32)
-                y = jnp.asarray(rng.integers(0, 10, shape[0]), jnp.int32)
-            else:
-                x = jnp.asarray(rng.integers(0, 1000, shape), jnp.int32)
-                y = (
-                    jnp.roll(x, -1, axis=1)
-                    if kind == "lm"
-                    else jnp.asarray(rng.integers(0, 2, shape[0]), jnp.int32)
-                )
-            variables = model.init(
-                {"params": jax.random.PRNGKey(0)}, x, train=False
-            )
-            params = variables["params"]
-            batch_stats = variables.get("batch_stats", {})
-            tx = get_optimizer("adamw", 1e-4)
-            criterion = get_criterion("cross_entropy")
-            state = TrainState(
-                step=jnp.zeros((), jnp.int32), params=params,
-                opt_state=tx.init(params), batch_stats=batch_stats,
-                rng=jax.random.PRNGKey(1),
-            )
-            has_bs = bool(batch_stats)
-
-            @jax.jit
-            def step(state, x, y):
-                def loss_fn(p):
-                    if has_bs:
-                        out, mut = model.apply(
-                            {"params": p, "batch_stats": state.batch_stats},
-                            x, train=True, mutable=["batch_stats"],
-                        )
-                        return criterion(out, y), mut["batch_stats"]
-                    out = model.apply({"params": p}, x, train=True)
-                    return criterion(out, y), state.batch_stats
-
-                (loss, new_bs), grads = jax.value_and_grad(
-                    loss_fn, has_aux=True
-                )(state.params)
-                updates, opt_state = tx.update(
-                    grads, state.opt_state, state.params
-                )
-                return (
-                    state.replace(
-                        step=state.step + 1,
-                        params=optax.apply_updates(state.params, updates),
-                        opt_state=opt_state,
-                        batch_stats=new_bs,
-                    ),
-                    loss,
-                )
-
-            # Compile ONCE; the same executable feeds the FLOPs analysis
-            # and the timing loop (a second jit-path compile would double
-            # the remote-compile tunnel cost).
-            t_c = time.time()
-            compiled = step.lower(state, x, y).compile()
-            print(f"# {name}: compiled in {time.time() - t_c:.0f}s",
-                  file=sys.stderr, flush=True)
-            flops = _compiled_flops(compiled)
-            rate, _ = _steady_state_rate(
-                compiled, state, [(x, y)], warmup=3, iters=20
-            )
-            # MFU only means something against the real chip's peak.
-            on_tpu = jax.default_backend() == "tpu"
-            mfu = rate * flops / _chip_peak_flops() if (flops and on_tpu) else None
-            rows.append((name, shape, rate * shape[0], mfu))
-        except Exception as e:  # keep the headline metric robust
-            rows.append((name, shape, f"FAILED: {type(e).__name__}: {e}", None))
     out = []
-    for name, shape, rate, mfu in rows:
-        if isinstance(rate, float):
-            mfu_s = f" MFU={mfu * 100:.1f}%" if mfu is not None else ""
-            print(f"# {name} {shape}: {rate:,.1f} samples/s{mfu_s}")
-            out.append(
-                {"model": name, "batch_shape": list(shape),
-                 "samples_per_sec": round(rate, 1),
-                 "mfu": round(mfu, 4) if mfu is not None else None}
+    for name, (shape, _kind, _kw) in EXTENDED_CONFIGS.items():
+        left = budget - (time.time() - t_start)
+        if left < 60:
+            row = {"model": name, "batch_shape": list(shape),
+                   "error": f"SKIPPED: extended budget ({budget:.0f}s) exhausted"}
+            out.append(row)
+            print(f"# {name} {shape}: {row['error']}")
+            continue
+        cmd = [sys.executable, __file__, "--one", name]
+        if jax.default_backend() != "tpu":
+            # Propagate the CPU fallback: a child re-runs sitecustomize and
+            # would pin the (possibly dead) TPU platform again; env vars
+            # don't survive that hook, a flag does.
+            cmd.append("--cpu")
+        try:
+            r = subprocess.run(
+                cmd,
+                timeout=min(per_model, left), capture_output=True, text=True,
             )
+            for line in (r.stderr or "").splitlines():
+                if line.startswith("# "):
+                    print(line, file=sys.stderr, flush=True)
+            parsed = None
+            for line in (r.stdout or "").splitlines():
+                if line.startswith("{"):
+                    parsed = json.loads(line)
+            if parsed is None:
+                tail = (r.stderr or "").strip().splitlines()
+                parsed = {
+                    "model": name, "batch_shape": list(shape),
+                    "error": f"FAILED: {tail[-1] if tail else 'no output'}",
+                }
+        except subprocess.TimeoutExpired as e:
+            # The child's stderr carries the where-did-it-hang progress
+            # lines ('# gpt2: init in ...') — the whole point of the
+            # subprocess isolation; keep the tail.
+            err_tail = ""
+            if e.stderr:
+                text = (
+                    e.stderr.decode(errors="replace")
+                    if isinstance(e.stderr, bytes) else e.stderr
+                )
+                progress = [
+                    ln for ln in text.splitlines() if ln.startswith("# ")
+                ]
+                err_tail = f" (last: {progress[-1]})" if progress else ""
+            parsed = {
+                "model": name, "batch_shape": list(shape),
+                "error": f"TIMEOUT: > {min(per_model, left):.0f}s "
+                         f"(tunnel){err_tail}",
+            }
+        except Exception as e:
+            # One model's subprocess bookkeeping (bad JSON, OS error) must
+            # never take down the table or the headline metric.
+            parsed = {
+                "model": name, "batch_shape": list(shape),
+                "error": f"FAILED: {type(e).__name__}: {e}",
+            }
+        out.append(parsed)
+        if "error" in parsed:
+            print(f"# {name} {shape}: {parsed['error']}")
         else:
-            print(f"# {name} {shape}: {rate}")
-            out.append({"model": name, "batch_shape": list(shape), "error": rate})
+            mfu = parsed.get("mfu")
+            mfu_s = f" MFU={mfu * 100:.1f}%" if mfu is not None else ""
+            print(
+                f"# {name} {shape}: {parsed['samples_per_sec']:,.1f} "
+                f"samples/s{mfu_s}"
+            )
     return out
 
 
@@ -335,8 +407,19 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--extended", action="store_true",
                         help="also bench the north-star model zoo")
+    parser.add_argument("--one", metavar="MODEL", default=None,
+                        help="bench a single north-star model, print one "
+                        "JSON line (used by --extended's subprocesses)")
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin the CPU backend (in-process config update "
+                        "— the only pin that survives sitecustomize)")
     parser.add_argument("--batch_size", type=int, default=32)
     args = parser.parse_args()
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    if args.one:
+        print(json.dumps(bench_one_model(args.one)), flush=True)
+        return
     record = {
         "metric": (
             f"train_samples_per_sec (MLModel/CIFAR-10, bs={args.batch_size}, "
@@ -365,7 +448,12 @@ def main():
     watchdog.daemon = True
     watchdog.start()
     try:
-        devices, note = _init_devices_with_retry()
+        if args.cpu:
+            # Pinned CPU: probing the default (TPU) backend would dial the
+            # tunnel this flag exists to avoid.
+            devices, note = jax.devices(), "CPU-pinned run (--cpu)"
+        else:
+            devices, note = _init_devices_with_retry()
         print(f"# devices: {devices}", file=sys.stderr)
         if note:
             record["note"] = note

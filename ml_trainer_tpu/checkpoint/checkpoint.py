@@ -236,12 +236,17 @@ def _reconcile_ema(state_template: Any, saved: Any) -> Any:
     want_ema = tpl["ema_params"] is not None
     have = saved.get("ema_params")
     if want_ema and have is None:
+        if "params" not in saved:
+            raise ValueError(
+                "Cannot seed EMA from checkpoint: it has no 'params' entry "
+                f"(found keys {sorted(saved)}) — the checkpoint is malformed."
+            )
         # EMA turned on for (or added to) this run: start it at the
         # restored params, exactly how a fresh Trainer seeds it.  Aliasing
         # the host arrays is fine — restore only reads them, and
         # device_put gives each leaf its own device buffer.
         saved = dict(saved)
-        saved["ema_params"] = saved.get("params")
+        saved["ema_params"] = saved["params"]
     elif not want_ema:
         saved = dict(saved)
         saved["ema_params"] = None
@@ -256,12 +261,18 @@ def _from_state_dict_compat(state_template: Any, saved: Any) -> Any:
     saved = _reconcile_ema(state_template, saved)
     try:
         return serialization.from_state_dict(state_template, saved)
-    except (ValueError, KeyError, AttributeError):
+    except (ValueError, KeyError, AttributeError) as orig:
         if not (isinstance(saved, dict) and "opt_state" in saved):
             raise
         wrapped = dict(saved)
         wrapped["opt_state"] = {"0": {}, "1": saved["opt_state"]}
-        return serialization.from_state_dict(state_template, wrapped)
+        try:
+            return serialization.from_state_dict(state_template, wrapped)
+        except Exception:
+            # The legacy re-nest didn't apply: the ORIGINAL mismatch (e.g.
+            # optimizer changed between save and resume) is the real story,
+            # not the fallback's secondary failure.
+            raise orig
 
 
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:

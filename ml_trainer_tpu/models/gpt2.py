@@ -86,3 +86,102 @@ def gpt2_moe_tiny(**kw) -> GPT2:
     test/demo config (mesh axis ``expert``, rules_for(..., 'ep'))."""
     kw.setdefault("moe_experts", 4)
     return gpt2_tiny(**kw)
+
+
+class GPT2Pipelined(nn.Module):
+    """Pipeline-parallel GPT-2 trainable through the Trainer.
+
+    The TPU-idiomatic stage split: the REPEATED, equal-width transformer
+    blocks form the pipeline trunk — their params live stacked
+    ``[n_stages, ...]`` and shard ``P('stage', ...)`` (PP_RULES), executing
+    through ``parallel.pipeline.pipeline_apply`` (activations hop stage →
+    stage over ICI ppermute inside one lax.scan).  The unequal-width ends —
+    token/position embedding and the tied LM head — run OUTSIDE the
+    pipeline, replicated: an SPMD pipeline needs shape-homogeneous stages,
+    so heterogeneous ends ride outside the trunk (the arrangement used by
+    production TPU pipelining; the reference has no PP at all, SURVEY.md
+    §2C).
+
+    With ``mesh=None`` the SAME stacked params fold serially via
+    ``lax.scan`` — one param structure for both execution modes, which is
+    what lets tests assert pipelined == serial trajectories exactly.
+    The trunk is dropout-free (GPipe microbatches would need per-stage RNG
+    plumbing; the reference parity configs train without dropout anyway).
+    """
+
+    vocab_size: int = 50257
+    max_len: int = 1024
+    embed_dim: int = 768
+    n_stages: int = 4
+    num_heads: int = 12
+    dtype: jnp.dtype = jnp.float32
+    mesh: object = None  # jax Mesh with a live 'stage' axis -> pipelined
+    n_microbatches: int = 0  # 0 -> one microbatch per stage
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False):
+        import jax
+
+        from ml_trainer_tpu.parallel.pipeline import pipeline_apply
+
+        b, s = input_ids.shape
+        tok_embed = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")
+        x = tok_embed(input_ids)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.01),
+            (1, self.max_len, self.embed_dim),
+        )
+        x = (x + pos[:, :s]).astype(self.dtype)
+
+        # One block TEMPLATE; its params are created stacked [n_stages, ...]
+        # so they shard over the stage mesh axis as a single pytree.
+        block = TransformerBlock(
+            num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
+            causal=True, dtype=self.dtype,
+        )
+
+        def stacked_init(rng):
+            dummy = jnp.zeros((1, 1, self.embed_dim), self.dtype)
+
+            def one(r):
+                return block.init({"params": r}, dummy, None, False)["params"]
+
+            return jax.vmap(one)(jax.random.split(rng, self.n_stages))
+
+        blocks = self.param("blocks", stacked_init)
+
+        def stage_fn(p, mb):
+            return block.apply({"params": p}, mb, None, False)
+
+        if self.mesh is not None and "stage" in getattr(
+            self.mesh, "axis_names", ()
+        ):
+            x = pipeline_apply(
+                stage_fn, blocks, x, self.mesh,
+                n_microbatches=self.n_microbatches or None,
+            )
+        else:
+            x, _ = jax.lax.scan(
+                lambda carry, p: (stage_fn(p, carry), None), x, blocks
+            )
+        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
+        logits = x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)
+        return logits
+
+
+@register_model("gpt2_pipe")
+def gpt2_pipe(**kw) -> GPT2Pipelined:
+    """GPT-2 124M with the 12 blocks as pipeline stages."""
+    kw.setdefault("n_stages", 12)
+    return GPT2Pipelined(**kw)
+
+
+@register_model("gpt2_pipe_tiny")
+def gpt2_pipe_tiny(**kw) -> GPT2Pipelined:
+    """Small pipelined GPT-2 for tests: 4 stages of 64-wide blocks."""
+    kw.setdefault("vocab_size", 256)
+    kw.setdefault("embed_dim", 64)
+    kw.setdefault("n_stages", 4)
+    kw.setdefault("num_heads", 2)
+    kw.setdefault("max_len", 128)
+    return GPT2Pipelined(**kw)

@@ -1,9 +1,12 @@
 """ResNet-18/50, NHWC flax — the BASELINE.json configs[0..1] models.
 
 TPU-first choices: NHWC layout throughout, 3×3/1×1 convs sized for MXU
-tiling, BatchNorm with local (per-replica) statistics — matching the
-reference's DDP behaviour, which does not synchronize BN either
-(torch DDP default; ref: src/trainer.py:98).  A ``cifar_stem`` variant
+tiling.  BatchNorm statistics under data parallelism are GLOBAL-batch:
+inside ``jit`` the batch mean/var are computed over the whole sharded
+batch (XLA inserts the cross-device reduction the sharding implies) —
+i.e. the SyncBN arrangement, not torch DDP's local per-replica stats
+(ref: src/trainer.py:98).  That is exactly why the DP-equals-single-device
+trajectory test holds bit-for-bit.  A ``cifar_stem`` variant
 replaces the 7×7/stride-2 + maxpool stem with a 3×3/stride-1 conv so
 ResNet-18 trains sensibly on 32×32 inputs (the local-path config).
 """

@@ -92,6 +92,7 @@ def pipeline_apply(
     *,
     axis_name: str = "stage",
     n_microbatches: int = None,
+    batch_axis: str = "data",
 ) -> jax.Array:
     """Run ``x`` through ``n_stages`` sequential stages, pipelined.
 
@@ -102,6 +103,11 @@ def pipeline_apply(
     ``n_microbatches`` equal microbatches (default: one per stage).
     Semantically equivalent to folding ``stage_fn`` serially; the pipeline
     only changes WHERE each stage runs and WHEN.
+
+    When the mesh also has a live ``batch_axis`` (dp × pp), each
+    microbatch's batch dim shards over it — the data-parallel replicas
+    pipeline their own slices and the gradient psum over ``data`` happens
+    outside, exactly as with any other sharded batch.
     """
     n_stages = mesh.shape[axis_name]
     n_micro = n_microbatches or n_stages
@@ -110,7 +116,10 @@ def pipeline_apply(
         raise ValueError(
             f"batch {batch} not divisible into {n_micro} microbatches"
         )
+    if batch_axis is not None and batch_axis not in mesh.axis_names:
+        batch_axis = None
     xm = x.reshape((n_micro, batch // n_micro) + x.shape[1:])
+    x_spec = P(None, batch_axis) if batch_axis else P()
     fn = shard_map(
         functools.partial(
             _pipeline_local,
@@ -121,9 +130,9 @@ def pipeline_apply(
         mesh=mesh,
         in_specs=(
             jax.tree.map(lambda _: P(axis_name), stage_params),
-            P(),
+            x_spec,
         ),
-        out_specs=P(),
+        out_specs=x_spec,
         check_vma=False,
     )
     out = fn(stage_params, xm)

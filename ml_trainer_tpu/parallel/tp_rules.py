@@ -59,16 +59,26 @@ EP_RULES = [
     (r"mlp/wo$", P("expert", None, None)),
 ]
 
+# Pipeline parallelism: the stacked per-stage trunk params [n_stages, ...]
+# of models.gpt2.GPT2Pipelined shard their leading (stage) dim; embedding /
+# head / final-LN replicate (they run outside the pipeline).  The pipeline
+# schedule itself lives in parallel.pipeline (shard_map + ppermute).
+PP_RULES = [
+    (r"(^|/)blocks/", P("stage")),
+]
+
 
 def rules_for(model_name: str, strategy: str = "tp"):
     """Pick a rule set by model family + strategy
-    ('tp' | 'fsdp' | 'tp+fsdp' | 'ep').  EP rules ride along with tp-family
-    sets — they only bite on meshes with a live ``expert`` axis (absent
-    axes are dropped by logical_to_shardings)."""
+    ('tp' | 'fsdp' | 'tp+fsdp' | 'ep' | 'pp').  EP rules ride along with
+    tp-family sets — they only bite on meshes with a live ``expert`` axis
+    (absent axes are dropped by logical_to_shardings)."""
     if strategy == "fsdp":
         return FSDP_RULES
     if strategy == "ep":
         return list(EP_RULES)
+    if strategy == "pp":
+        return list(PP_RULES)
     rules = list(TRANSFORMER_TP_RULES) + list(EP_RULES)
     if strategy == "tp+fsdp":
         rules += FSDP_RULES

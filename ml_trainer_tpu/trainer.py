@@ -428,7 +428,15 @@ class Trainer:
         sample_x = jnp.asarray(sample_x[: max(self.global_batch // process_count(), 1)])
         self.rng, init_rng, dropout_rng = jax.random.split(self.rng, 3)
         init_kwargs = {"train": False} if self._takes_train else {}
-        variables = self.model.init(
+        # jit the init: flax executes it eagerly by default (one device
+        # dispatch per op), which over a remote TPU tunnel is one round
+        # trip per op — minutes for a ResNet.  Jitted it is one compile +
+        # one execution.
+        init_fn = jax.jit(
+            self.model.init,
+            static_argnames="train" if self._takes_train else (),
+        )
+        variables = init_fn(
             {"params": init_rng, "dropout": dropout_rng}, sample_x, **init_kwargs
         )
         params = variables["params"]
@@ -503,7 +511,7 @@ class Trainer:
                     getattr(x, "sharding", None), jax.sharding.NamedSharding
                 )
                 else jax.device_put(x, self._replicated),
-                self.tx.init(params),
+                jax.jit(self.tx.init)(params),
             )
             if self._shard_opt_state:
                 # Model-sharded params (TP/FSDP rules): re-place only the
@@ -1015,9 +1023,7 @@ class Trainer:
             raise ValueError("test_loader yields no batches")
         loss_sum = jnp.zeros(())
         metric_sum = jnp.zeros(())
-        # Same mesh placement as validation: batch split over the data axis,
-        # variables replicated (loaded checkpoints arrive as host numpy).
-        variables = jax.device_put(variables, self._replicated)
+        variables = self._place_eval_variables(variables)
         d = self._data_parallel
 
         def shardable(batch):
@@ -1050,6 +1056,22 @@ class Trainer:
         if self.metric:
             return test_loss, float(metric_sum) / n
         return test_loss
+
+    def _place_eval_variables(self, variables):
+        """Mesh placement for eval/test variables: leaves already carrying a
+        NamedSharding — the trained state, possibly TP/FSDP-partitioned —
+        KEEP it (forcing them replicated would all-gather the very params
+        the sharding exists to split, and OOM exactly on the models that
+        need sharding); only host-loaded leaves (checkpoints arrive as
+        numpy) are placed, replicated."""
+        def place(leaf):
+            if isinstance(
+                getattr(leaf, "sharding", None), jax.sharding.NamedSharding
+            ):
+                return leaf
+            return jax.device_put(leaf, self._replicated)
+
+        return jax.tree.map(place, variables)
 
     def _resolve_model(self, model) -> Tuple[Any, dict]:
         if model is None:

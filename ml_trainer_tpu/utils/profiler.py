@@ -34,10 +34,17 @@ def force(fence: Any) -> None:
     A device→host read of an output element is data-dependent on the whole
     chain of dispatched executables, so it forces real execution on every
     platform.  Fetches the smallest output leaf (usually a scalar: loss or
-    the step counter) to keep the transfer negligible."""
+    the step counter) to keep the transfer negligible.
+
+    ``block_until_ready`` runs FIRST over the whole tree: on honest
+    platforms it is the complete fence (covering leaves from different
+    dispatches/devices that the single-leaf fetch would not), and the
+    data-dependent fetch then closes the remote-tunnel loophole — both
+    guarantees, not one."""
     leaves = [x for x in jax.tree.leaves(fence) if hasattr(x, "shape")]
     if not leaves:
         return
+    jax.block_until_ready(leaves)
     smallest = min(leaves, key=lambda x: getattr(x, "size", 1))
     np.asarray(jax.device_get(smallest))
 

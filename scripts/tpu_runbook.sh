@@ -4,6 +4,10 @@
 # remote tunnel.  Each stage logs to /tmp/tpu_runbook/.
 set -u
 cd "$(dirname "$0")/.."
+# examples/ and scripts/ import the package from the repo root; running
+# them as `python examples/01_...py` puts examples/ (not the root) on
+# sys.path, so export the root explicitly.
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 OUT=/tmp/tpu_runbook
 mkdir -p "$OUT" tests/golden
 

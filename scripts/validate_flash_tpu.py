@@ -28,13 +28,27 @@ from ml_trainer_tpu.ops.attention import dot_product_attention, flash_attention 
 def bench(fn, *args, iters=20):
     from ml_trainer_tpu.utils.profiler import force
 
-    force(fn(*args))  # compile + warm (force: block_until_ready lies on
-    #                   the remote tunnel — see profiler.force docstring)
+    # Iterations must be DATA-DEPENDENT: on this platform in-order stream
+    # scheduling cannot be assumed (the observation behind force()), so
+    # fencing only the last of N independent calls would not prove the
+    # other N-1 ran inside the window.  A lax.scan threading one output
+    # element back into the next iteration's input chains every call
+    # inside ONE compiled program — provably-complete timing with a single
+    # dispatch (per-op eager chaining would pay one tunnel round trip per
+    # link and measure dispatch, not kernels).
+    @jax.jit
+    def run_n(first, *rest):
+        def body(carry, _):
+            out = fn(carry, *rest)
+            leaf = jnp.ravel(jax.tree.leaves(out)[0])[0]
+            return first + (leaf * 0).astype(first.dtype), None
+
+        carry, _ = jax.lax.scan(body, first, None, length=iters)
+        return carry
+
+    force(run_n(*args))  # compile + warm
     t0 = time.perf_counter()
-    out = None
-    for _ in range(iters):
-        out = fn(*args)
-    force(out)
+    force(run_n(*args))
     return (time.perf_counter() - t0) / iters
 
 
