@@ -17,6 +17,29 @@ from ml_trainer_tpu.models.layers import TransformerBlock
 from ml_trainer_tpu.models.registry import register_model
 
 
+def _embed_input(mdl: nn.Module, input_ids):
+    """Shared non-trunk front end for the GPT-2 variants: token embedding +
+    learned positions (params ``tok_embed``/``pos_embed`` on ``mdl`` — one
+    definition so GPT2 and GPT2Pipelined cannot drift apart).  Returns the
+    embedded activations and the embed module for head tying."""
+    s = input_ids.shape[1]
+    tok_embed = nn.Embed(mdl.vocab_size, mdl.embed_dim, name="tok_embed")
+    x = tok_embed(input_ids)
+    pos = mdl.param(
+        "pos_embed", nn.initializers.normal(0.01),
+        (1, mdl.max_len, mdl.embed_dim),
+    )
+    return (x + pos[:, :s]).astype(mdl.dtype), tok_embed
+
+
+def _tied_head(mdl: nn.Module, x, tok_embed):
+    """Shared back end: final LayerNorm + weight-tied LM head (logits =
+    h @ tok_embedᵀ — halves embedding memory, the published GPT-2
+    arrangement)."""
+    x = nn.LayerNorm(dtype=mdl.dtype, name="ln_final")(x)
+    return x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)
+
+
 class GPT2(nn.Module):
     vocab_size: int = 50257
     max_len: int = 1024
@@ -33,14 +56,7 @@ class GPT2(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
-        b, s = input_ids.shape
-        tok_embed = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")
-        x = tok_embed(input_ids)
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.01),
-            (1, self.max_len, self.embed_dim),
-        )
-        x = (x + pos[:, :s]).astype(self.dtype)
+        x, tok_embed = _embed_input(self, input_ids)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         # remat: recompute each block's activations in the backward pass
@@ -57,10 +73,7 @@ class GPT2(nn.Module):
                 attention_impl=self.attention_impl, mesh=self.mesh,
                 moe_experts=self.moe_experts, name=f"block{i}",
             )(x, None, train)
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
-        # Tied LM head: reuse the token embedding matrix.
-        logits = x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)
-        return logits
+        return _tied_head(self, x, tok_embed)
 
 
 @register_model("gpt2")
@@ -124,14 +137,7 @@ class GPT2Pipelined(nn.Module):
 
         from ml_trainer_tpu.parallel.pipeline import pipeline_apply
 
-        b, s = input_ids.shape
-        tok_embed = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")
-        x = tok_embed(input_ids)
-        pos = self.param(
-            "pos_embed", nn.initializers.normal(0.01),
-            (1, self.max_len, self.embed_dim),
-        )
-        x = (x + pos[:, :s]).astype(self.dtype)
+        x, tok_embed = _embed_input(self, input_ids)
 
         # One block TEMPLATE; its params are created stacked [n_stages, ...]
         # so they shard over the stage mesh axis as a single pytree.
@@ -164,9 +170,7 @@ class GPT2Pipelined(nn.Module):
             x, _ = jax.lax.scan(
                 lambda carry, p: (stage_fn(p, carry), None), x, blocks
             )
-        x = nn.LayerNorm(dtype=self.dtype, name="ln_final")(x)
-        logits = x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)
-        return logits
+        return _tied_head(self, x, tok_embed)
 
 
 @register_model("gpt2_pipe")

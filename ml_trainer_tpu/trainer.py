@@ -505,13 +505,25 @@ class Trainer:
             )
             opt_state = jax.jit(self.tx.init, out_shardings=out_sh)(params)
         else:
+            # Rule-sharded params (TP/FSDP): EAGER init, so each moment is
+            # born with its param's NamedSharding (jit would erase them to
+            # SingleDeviceSharding and the map below would then replicate
+            # the moments — the memory blowup sharding exists to prevent).
+            # Replicated params (pure DP, incl. the single-chip tunnel
+            # where eager per-op dispatch is the hazard): jit is safe, the
+            # map re-places everything replicated anyway.
+            init_fn = (
+                self.tx.init
+                if self._sharding_rules is not None
+                else jax.jit(self.tx.init)
+            )
             opt_state = jax.tree.map(
                 lambda x: x
                 if isinstance(
                     getattr(x, "sharding", None), jax.sharding.NamedSharding
                 )
                 else jax.device_put(x, self._replicated),
-                jax.jit(self.tx.init)(params),
+                init_fn(params),
             )
             if self._shard_opt_state:
                 # Model-sharded params (TP/FSDP rules): re-place only the

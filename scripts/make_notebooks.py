@@ -51,6 +51,16 @@ The `01_ML_Training_local` flow on a TPU chip: build datasets → config →
 (`src/trainer.py:22-311`), internals are one compiled XLA step.
 """),
     ("code", """
+# NB_REHEARSAL=1 pins the CPU backend (the TPU-down fallback; the driver's
+# TPU runbook re-executes without it so committed outputs show the chip).
+import os
+if os.environ.get("NB_REHEARSAL", "0") == "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+import jax
+jax.devices()
+"""),
+    ("code", """
 from ml_trainer_tpu import (
     MLModel, Loader, Trainer, load_history, load_model, plot_history,
 )
@@ -202,6 +212,10 @@ also accepts a reference torch `model.pth` (the `module.`-prefix-tolerant
 import with OIHW→HWIO conversion, ref: `src/utils/utils.py:15-28`).
 """),
     ("code", """
+import os
+if os.environ.get("NB_REHEARSAL", "0") == "1":
+    import jax
+    jax.config.update("jax_platforms", "cpu")
 from ml_trainer_tpu import MLModel, Loader, Trainer, load_model
 from ml_trainer_tpu.data import CIFAR10, SyntheticCIFAR10
 from ml_trainer_tpu.utils.functions import custom_pre_process_function

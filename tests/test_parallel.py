@@ -92,6 +92,15 @@ def test_tensor_parallel_training_runs_and_matches(tmp_path):
     # qkv kernels actually sharded over the tensor axis:
     qkv = t_tp.state.params["block0"]["attn"]["qkv"]["kernel"]
     assert qkv.sharding.spec == P(None, "tensor")
+    # ... and the optimizer moments INHERIT that sharding rather than
+    # being replicated (regression: jitted tx.init erased the param
+    # shardings and the placement pass then replicated every moment).
+    moment_specs = {
+        leaf.sharding.spec
+        for leaf in jax.tree.leaves(t_tp.state.opt_state)
+        if hasattr(leaf, "ndim") and leaf.ndim >= 2
+    }
+    assert P(None, "tensor") in moment_specs, moment_specs
     t_tp.fit()
     np.testing.assert_allclose(t_dp.train_losses, t_tp.train_losses, rtol=1e-3)
 
