@@ -505,26 +505,41 @@ class Trainer:
             )
             opt_state = jax.jit(self.tx.init, out_shardings=out_sh)(params)
         else:
-            # Rule-sharded params (TP/FSDP): EAGER init, so each moment is
-            # born with its param's NamedSharding (jit would erase them to
-            # SingleDeviceSharding and the map below would then replicate
-            # the moments — the memory blowup sharding exists to prevent).
-            # Replicated params (pure DP, incl. the single-chip tunnel
-            # where eager per-op dispatch is the hazard): jit is safe, the
-            # map re-places everything replicated anyway.
-            init_fn = (
-                self.tx.init
-                if self._sharding_rules is not None
-                else jax.jit(self.tx.init)
-            )
-            opt_state = jax.tree.map(
-                lambda x: x
-                if isinstance(
-                    getattr(x, "sharding", None), jax.sharding.NamedSharding
+            if self._sharding_rules is not None:
+                # Rule-sharded params (TP/FSDP): moments must INHERIT each
+                # param's sharding (replicating them is the memory blowup
+                # sharding exists to prevent).  jit alone erases the
+                # shardings (zeros_like has no data dependence for GSPMD to
+                # propagate) and eager init would crash on multi-host
+                # non-addressable arrays — so jit with explicit
+                # out_shardings, mapped from the params by shape (shapes
+                # repeating across layers carry the same rule; ambiguous
+                # shapes fall back replicated, a memory — not correctness —
+                # concession).
+                by_shape: dict = {}
+                for p in jax.tree.leaves(params):
+                    cur = by_shape.get(p.shape)
+                    if cur is None:
+                        by_shape[p.shape] = p.sharding
+                    elif cur != p.sharding:
+                        by_shape[p.shape] = self._replicated
+                out_sh = jax.tree.map(
+                    lambda l: by_shape.get(l.shape, self._replicated),
+                    jax.eval_shape(self.tx.init, params),
                 )
-                else jax.device_put(x, self._replicated),
-                init_fn(params),
-            )
+                opt_state = jax.jit(self.tx.init, out_shardings=out_sh)(params)
+            else:
+                # Replicated params (pure DP, incl. the single-chip tunnel
+                # where eager per-op dispatch is the hazard): jit is safe,
+                # the map re-places everything replicated anyway.
+                opt_state = jax.tree.map(
+                    lambda x: x
+                    if isinstance(
+                        getattr(x, "sharding", None), jax.sharding.NamedSharding
+                    )
+                    else jax.device_put(x, self._replicated),
+                    jax.jit(self.tx.init)(params),
+                )
             if self._shard_opt_state:
                 # Model-sharded params (TP/FSDP rules): re-place only the
                 # still-replicated leaves, leaving rule-sharded moments be.

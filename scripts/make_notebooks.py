@@ -24,6 +24,38 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 OUT = os.path.join(ROOT, "notebooks")
 
 
+def _rehearsal_cell(default: str, devices: int = 0) -> str:
+    """One shared backend-guard cell for all three notebooks.
+
+    ``default`` — "1" for notebooks whose committed form runs rehearsed
+    (02: the multi-chip flow needs a virtual mesh in this 1-chip
+    environment), "0" for notebooks meant to run on the chip (01/03;
+    NB_REHEARSAL=1 is their TPU-down fallback, and the committed outputs
+    record whichever backend actually ran — check the cell output).
+    ``devices`` > 0 also forces that many virtual host-CPU devices."""
+    flags = ""
+    if devices:
+        flags = f"""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count={devices}"
+    ).strip()"""
+    return f"""
+import os
+# Rehearsal mode (NB_REHEARSAL={default} here): pin the host-CPU backend.
+# On a real TPU host set NB_REHEARSAL=0 and the mesh picks up the chips;
+# the cell's output below records which backend this notebook really ran.
+if os.environ.get("NB_REHEARSAL", "{default}") == "1":
+    os.environ["JAX_PLATFORMS"] = "cpu"{flags}
+import jax
+if os.environ.get("NB_REHEARSAL", "{default}") == "1":
+    # jax may already be imported by interpreter-startup site hooks with a
+    # TPU platform pinned; the config override wins (backends init lazily).
+    jax.config.update("jax_platforms", "cpu")
+jax.devices()
+"""
+
+
 def _nb(cells):
     nb = nbf.v4.new_notebook()
     nb.metadata.kernelspec = {
@@ -50,16 +82,7 @@ The `01_ML_Training_local` flow on a TPU chip: build datasets → config →
 `load_model` → `test()`.  Same public surface as the reference
 (`src/trainer.py:22-311`), internals are one compiled XLA step.
 """),
-    ("code", """
-# NB_REHEARSAL=1 pins the CPU backend (the TPU-down fallback; the driver's
-# TPU runbook re-executes without it so committed outputs show the chip).
-import os
-if os.environ.get("NB_REHEARSAL", "0") == "1":
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-import jax
-jax.devices()
-"""),
+    ("code", _rehearsal_cell(default="0")),
     ("code", """
 from ml_trainer_tpu import (
     MLModel, Loader, Trainer, load_history, load_model, plot_history,
@@ -137,23 +160,7 @@ every chip.  This notebook runs the same cells in CPU-mesh rehearsal mode
 rehearsal) so the full distributed path executes anywhere; on a TPU slice
 the environment cell is a no-op and the mesh picks up the real chips.
 """),
-    ("code", """
-import os
-# Rehearsal mode: 8 virtual host-CPU devices.  On a real TPU slice, remove
-# this cell (or leave it — it only applies when no TPU is attached).
-if os.environ.get("NB_REHEARSAL", "1") == "1":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
-import jax
-if os.environ.get("NB_REHEARSAL", "1") == "1":
-    # jax may already be imported by interpreter-startup site hooks with a
-    # TPU platform pinned; the config override wins (backends init lazily).
-    jax.config.update("jax_platforms", "cpu")
-jax.devices()
-"""),
+    ("code", _rehearsal_cell(default="1", devices=8)),
     ("code", """
 from ml_trainer_tpu import Trainer
 from ml_trainer_tpu.data import SyntheticCIFAR10
@@ -211,11 +218,8 @@ The `03_ML_Testing` flow: build a test loader → `load_model` → a
 also accepts a reference torch `model.pth` (the `module.`-prefix-tolerant
 import with OIHW→HWIO conversion, ref: `src/utils/utils.py:15-28`).
 """),
+    ("code", _rehearsal_cell(default="0")),
     ("code", """
-import os
-if os.environ.get("NB_REHEARSAL", "0") == "1":
-    import jax
-    jax.config.update("jax_platforms", "cpu")
 from ml_trainer_tpu import MLModel, Loader, Trainer, load_model
 from ml_trainer_tpu.data import CIFAR10, SyntheticCIFAR10
 from ml_trainer_tpu.utils.functions import custom_pre_process_function

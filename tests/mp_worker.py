@@ -1,0 +1,91 @@
+"""Worker process for tests/test_multiprocess.py.
+
+Each worker is one "host" of a 2-process CPU cluster: 4 local virtual
+devices, ``jax.distributed.initialize`` rendezvous, then the code paths
+that are dead under the usual single-process simulated mesh (SURVEY.md §4
+implication (c)): the per-host sampler split + multi-host prefetch
+assembly (``make_array_from_process_local_data``), rank-0 checkpointing
+with the broadcast resume, and the cross-host desync detector — including
+a forced-desync negative case.
+
+Usage: python mp_worker.py <coordinator_port> <process_id> <workdir>
+"""
+
+import os
+import sys
+
+port, pid, workdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+# CPU pin must be the in-process config update — the interpreter site hook
+# pins an experimental TPU platform that env vars cannot override.
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+assert len(jax.local_devices()) == 4
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ml_trainer_tpu import MLModel, Trainer  # noqa: E402
+from ml_trainer_tpu.data import SyntheticCIFAR10  # noqa: E402
+from ml_trainer_tpu.parallel.desync import check_desync  # noqa: E402
+from ml_trainer_tpu.utils.functions import (  # noqa: E402
+    custom_pre_process_function,
+)
+
+transform = custom_pre_process_function()  # normalize — raw 0-255 pixels
+# make the loss scale meaningless for the cross-rank equality check
+datasets = (
+    SyntheticCIFAR10(size=64, seed=0, transform=transform),
+    SyntheticCIFAR10(size=32, seed=1, transform=transform),
+)
+common = dict(
+    batch_size=16, model_dir=workdir, is_parallel=True, backend="cpu",
+    seed=5, lr=0.001, optimizer="adam", metric=None,
+)
+
+# --- multi-host training: sampler split + prefetch assembly + desync check
+t = Trainer(MLModel(), datasets=datasets, epochs=2, **common)
+sampler = t.train_loader.sampler
+assert getattr(sampler, "num_replicas", 1) == 2, sampler
+t.fit()
+assert all(np.isfinite(v) for v in t.train_losses)
+print(f"LOSSES {t.train_losses}", flush=True)
+
+# --- healthy state: fingerprints agree across hosts
+check_desync({"params": t.state.params})
+print("DESYNC_CLEAN_OK", flush=True)
+
+# --- resume: host 0 finds the checkpoint, decision + state broadcast
+t2 = Trainer(MLModel(), datasets=datasets, epochs=3, **common)
+t2.fit(resume=True)
+assert len(t2.train_losses) == 3, t2.train_losses
+assert t2.train_losses[:2] == t.train_losses, (t2.train_losses, t.train_losses)
+print(f"RESUME_OK {t2.train_losses}", flush=True)
+
+# --- forced desync: perturb THIS host's local replica only (host-local
+# numpy copies; a global-array op would need every process to join in)
+local = jax.tree.map(
+    lambda p: np.asarray(p.addressable_data(0)), t2.state.params
+)
+if pid == 1:
+    local = jax.tree.map(lambda a: a + 100.0, local)
+try:
+    check_desync(local)
+    detected = False
+except RuntimeError:
+    detected = True
+# Only the diverged (non-zero) host compares against host 0's broadcast.
+assert detected == (pid == 1), (detected, pid)
+print("DESYNC_FORCED_OK", flush=True)
+print("WORKER_DONE", flush=True)

@@ -1,0 +1,72 @@
+"""Real multi-process distributed execution (VERDICT r2 #6).
+
+The rest of the suite runs single-process on a simulated 8-device mesh,
+which leaves the genuinely multi-host branches dead: the
+``jax.distributed.initialize`` rendezvous, the per-host sampler split +
+``make_array_from_process_local_data`` assembly in ``prefetch_to_device``,
+``_resume_from_latest``'s broadcast, and ``check_desync``.  This test
+launches TWO worker processes (4 virtual CPU devices each → one 8-device
+cluster) and runs them all — the TPU-native analog of rehearsing the
+reference's SMDDP path with multiple real processes rather than one
+process pretending (SURVEY.md §4).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_training_resume_and_desync(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    # The workers build their own device topology; drop the suite's flags.
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(port), str(pid), str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=540)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        # Collect what each worker managed to say (communicate() on the
+        # finished ranks already closed their pipes — reuse those outputs).
+        for p in procs:
+            p.kill()
+        for p in procs[len(outs):]:
+            try:
+                out, _ = p.communicate(timeout=10)
+            except Exception:
+                out = "<no output recovered>"
+            outs.append(out)
+        pytest.fail("multi-process workers timed out:\n" + "\n".join(outs))
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        for marker in (
+            "LOSSES", "DESYNC_CLEAN_OK", "RESUME_OK", "DESYNC_FORCED_OK",
+            "WORKER_DONE",
+        ):
+            assert marker in out, f"rank {rank} missing {marker}:\n{out}"
+    # Both hosts observed the SAME global losses (one logical run).
+    losses = [
+        line for out in outs for line in out.splitlines()
+        if line.startswith("LOSSES ")
+    ]
+    assert len(losses) == 2 and losses[0] == losses[1], losses
