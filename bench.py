@@ -88,6 +88,17 @@ def _steady_state_rate(step, state, batches, warmup=5, iters=50):
     return timer.rate(), state
 
 
+PARITY_DS_SIZE = 2048  # synthetic dataset behind bench_parity
+
+
+def _effective_k(batch_size: int, steps_per_execution: int = 32) -> int:
+    """The multi-step K bench_parity will actually use — large batches
+    leave too few batches per epoch and clamp K down to 1."""
+    return max(
+        1, min(steps_per_execution, PARITY_DS_SIZE // batch_size // 2)
+    )
+
+
 def bench_parity(batch_size=32, steps_per_execution=32):
     """The reference workload through the real Trainer train step.
 
@@ -99,10 +110,12 @@ def bench_parity(batch_size=32, steps_per_execution=32):
     from ml_trainer_tpu.data import SyntheticCIFAR10
     from ml_trainer_tpu.utils.functions import custom_pre_process_function
 
-    ds = SyntheticCIFAR10(size=2048, transform=custom_pre_process_function())
+    ds = SyntheticCIFAR10(
+        size=PARITY_DS_SIZE, transform=custom_pre_process_function()
+    )
     # Large batch sizes leave few batches per epoch: cap K so at least one
     # full stack exists, falling back to the per-batch path at K=1.
-    k = max(1, min(steps_per_execution, len(ds) // batch_size // 2))
+    k = _effective_k(batch_size, steps_per_execution)
     trainer = Trainer(
         MLModel(), datasets=(ds, ds), epochs=1, batch_size=batch_size,
         model_dir="/tmp/bench_model", metric="accuracy", lr=0.01,
@@ -413,6 +426,10 @@ def main():
     parser.add_argument("--cpu", action="store_true",
                         help="pin the CPU backend (in-process config update "
                         "— the only pin that survives sitecustomize)")
+    parser.add_argument("--reconcile", action="store_true",
+                        help="measure BOTH dispatch paths (per-batch and "
+                        "multi-step) in one session with the fenced timer "
+                        "and report them side by side")
     parser.add_argument("--batch_size", type=int, default=32)
     args = parser.parse_args()
     if args.cpu:
@@ -460,7 +477,30 @@ def main():
         if args.extended:
             bench_loaders()
             record["extended"] = bench_extended()
-        samples_per_sec = bench_parity(args.batch_size)
+        if args.reconcile:
+            # Same session, same fenced StepTimer, both dispatch paths —
+            # the only honest way to compare them (numbers from different
+            # sessions/fences produced a 3x contradiction in round 2).
+            # The per-batch result is written into the record IMMEDIATELY
+            # so a hang/exception in the second pass cannot lose it.
+            per_batch = bench_parity(args.batch_size, steps_per_execution=1)
+            record["per_batch_samples_per_sec"] = round(per_batch, 1)
+            print(f"# reconcile per-batch: {per_batch:,.1f} samples/s",
+                  flush=True)
+            k = _effective_k(args.batch_size)
+            if k > 1:
+                samples_per_sec = bench_parity(args.batch_size)
+                print(f"# reconcile multi-step (k={k}): "
+                      f"{samples_per_sec:,.1f} samples/s "
+                      f"({samples_per_sec / per_batch:.2f}x per-batch)",
+                      flush=True)
+            else:
+                print("# reconcile: multi-step collapses to k=1 at batch "
+                      f"{args.batch_size} — single path, nothing to compare",
+                      flush=True)
+                samples_per_sec = per_batch
+        else:
+            samples_per_sec = bench_parity(args.batch_size)
         record["value"] = round(samples_per_sec, 1)
         record["vs_baseline"] = round(
             samples_per_sec / BASELINE_SAMPLES_PER_SEC, 2

@@ -15,8 +15,11 @@ echo "== probe =="
 timeout 240 python -u -c "import jax; print(jax.devices())" || {
   echo "TPU unavailable; aborting runbook"; exit 1; }
 
-echo "== 1. headline bench =="
-timeout 1200 python bench.py | tee "$OUT/bench_headline.out"
+echo "== 1. headline bench (per-batch vs multi-step reconciliation) =="
+# In-process watchdog BELOW the shell timeout so a hang still emits the
+# safety JSON line before SIGTERM (the driver needs a parseable record).
+BENCH_WATCHDOG_SECS=1500 timeout 1700 \
+  python bench.py --reconcile | tee "$OUT/bench_headline.out"
 
 echo "== 2. extended bench (budgeted) =="
 BENCH_WATCHDOG_SECS=2800 EXTENDED_BUDGET_SECS=1800 timeout 3000 \
