@@ -53,7 +53,9 @@ def build_datasets(vocab_size):
 
 
 def main():
-    model_kw = {"num_classes": 2}
+    # right_padded: TokenizedDataset pads on the right by construction, so
+    # the padding masks compress to kv_lens and run the fused flash kernel.
+    model_kw = {"num_classes": 2, "right_padded": True}
     vocab_size = 30522
     if MODEL == "bert_tiny":
         vocab_size = 2048

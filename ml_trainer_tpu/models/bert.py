@@ -5,6 +5,16 @@ Post-LN encoder blocks (the original BERT arrangement) over token +
 position + segment embeddings; classification from the [CLS] position
 through a tanh pooler.  Padding is handled with an attention mask built
 from ``attention_mask`` input (1 = keep), threaded to ops.attention.
+
+``right_padded=True`` (opt-in) declares every attention mask a contiguous
+prefix (standard right-padded tokenizer output, like this framework's
+``TokenizedDataset``): the mask is then ALSO summarized into per-sequence
+valid-key counts (``kv_lens``) so padded batches run the fused Pallas
+flash kernel instead of the XLA mask fallback.  The default is False —
+exact for ARBITRARY masks via the XLA path — because a non-prefix mask
+under ``right_padded=True`` would be silently mis-masked on the flash
+path (lengths cannot represent holes); opt in only where right padding
+holds by construction.
 """
 
 from __future__ import annotations
@@ -32,6 +42,7 @@ class BertEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
     remat: bool = False  # jax.checkpoint each block (backward recompute)
+    right_padded: bool = False  # opt-in: masks are contiguous prefixes
 
     @nn.compact
     def __call__(self, input_ids, attention_mask=None, token_type_ids=None,
@@ -56,9 +67,17 @@ class BertEncoder(nn.Module):
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         mask = None
+        kv_lens = None
         if attention_mask is not None:
             # [B, S] (1 = real token) -> [B, 1, 1, S] broadcastable boolean.
             mask = attention_mask[:, None, None, :].astype(bool)
+            if self.right_padded:
+                # Right-padded masks compress to valid-key counts, which the
+                # flash kernel fuses (ops.attention kv_lens); clamp to >= 1
+                # so an all-pad row still has a defined softmax.
+                kv_lens = jnp.maximum(
+                    attention_mask.astype(jnp.int32).sum(axis=-1), 1
+                )
         Block = (
             nn.remat(TransformerBlock, static_argnums=(3,))
             if self.remat
@@ -70,7 +89,7 @@ class BertEncoder(nn.Module):
                 dropout_rate=self.dropout_rate, post_norm=True,
                 dtype=self.dtype, attention_impl=self.attention_impl,
                 name=f"layer{i}",
-            )(x, mask, train)
+            )(x, mask, train, kv_lens)
         if self.num_classes is None:
             return x  # sequence output (feature-extractor mode)
         pooled = jnp.tanh(

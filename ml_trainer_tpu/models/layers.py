@@ -36,7 +36,7 @@ class MultiHeadAttention(nn.Module):
     mesh: Optional[object] = None  # jax Mesh, required for 'ring'
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False):
+    def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
         embed = x.shape[-1]
         head_dim = self.head_dim or embed // self.num_heads
         inner = self.num_heads * head_dim
@@ -51,7 +51,7 @@ class MultiHeadAttention(nn.Module):
 
         out = attention(
             heads(q), heads(k), heads(v),
-            causal=self.causal, mask=mask,
+            causal=self.causal, mask=mask, kv_lens=kv_lens,
             implementation=self.attention_impl,
             mesh=self.mesh,
         )
@@ -97,12 +97,12 @@ class TransformerBlock(nn.Module):
     moe_experts: int = 0  # >0: MoE feed-forward (expert parallelism)
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False):
+    def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
         attn = lambda y: MultiHeadAttention(
             self.num_heads, causal=self.causal, dropout_rate=self.dropout_rate,
             dtype=self.dtype, attention_impl=self.attention_impl,
             mesh=self.mesh, name="attn",
-        )(y, mask=mask, train=train)
+        )(y, mask=mask, train=train, kv_lens=kv_lens)
         if self.moe_experts:
             from ml_trainer_tpu.models.moe import MoEMLP
 

@@ -26,6 +26,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _mask_bias(mask, dtype):
@@ -63,14 +64,23 @@ def dot_product_attention(
 
 
 # --------------------------------------------------------------------- flash
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr, *,
-                  block_k: int, causal: bool, scale: float):
+def _flash_kernel(*refs, block_k: int, causal: bool, scale: float,
+                  masked: bool):
     """One (batch·head, q-block, kv-block) grid step of the online-softmax
     recurrence.  KV streams through VMEM one [block_k, D] tile at a time
     (the kv grid axis iterates fastest), with running (o, m, l) accumulators
     in VMEM scratch that persist across kv steps; the final kv step
-    normalizes and writes the output block."""
+    normalizes and writes the output block.  With ``masked`` a per-sequence
+    valid-key count streams in via SMEM and columns past it are dropped —
+    the right-padded (BERT) mask family, fused into the kernel instead of
+    falling back to the XLA path."""
     from jax.experimental import pallas as pl
+
+    if masked:
+        q_ref, k_ref, v_ref, lens_ref, o_ref, lse_ref, o_scr, m_scr, l_scr = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr = refs
+        lens_ref = None
 
     _, block_q, d = q_ref.shape
     kv_idx = pl.program_id(2)
@@ -86,8 +96,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr, *,
         l_scr[:] = jnp.zeros((block_q, 1), jnp.float32)
 
     # Under causal masking, blocks fully above the diagonal contribute
-    # nothing — skip their matmuls entirely.
+    # nothing — skip their matmuls entirely; likewise blocks entirely in
+    # the padded key tail.
+    kv_len = lens_ref[pl.program_id(0)] if masked else None
     live = (q_start + block_q > kv_start) if causal else True
+    if masked:
+        live = jnp.logical_and(live, kv_start < kv_len)
 
     @pl.when(live)
     def _attend():
@@ -98,10 +112,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr, *,
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            keep = (q_start + row) >= (kv_start + col)
+        keep = _keep_mask(
+            (block_q, block_k), q_start, kv_start, kv_len, causal, masked,
+        )
+        if keep is not None:
             scores = jnp.where(keep, scores, jnp.finfo(jnp.float32).min)
         m_prev, l_prev = m_scr[:], l_scr[:]
         m_cur = jnp.max(scores, axis=-1, keepdims=True)
@@ -123,7 +137,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, o_scr, m_scr, l_scr, *,
         lse_ref[0] = (m_scr[:] + jnp.log(l_scr[:]))[:, 0]
 
 
-def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
+def _lens_per_bh(kv_lens, b, h):
+    """[B] valid-key counts -> [B*H] int32 (one per grid row)."""
+    return jnp.repeat(kv_lens.astype(jnp.int32), h)
+
+
+def _flash_forward(q, k, v, kv_lens, *, causal, scale, block_q, block_k,
+                   interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -132,21 +152,28 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
     qr = q.reshape(b * h, s_q, d)
     kr = k.reshape(b * h, s_k, d)
     vr = v.reshape(b * h, s_k, d)
+    masked = kv_lens is not None
     kernel = functools.partial(
-        _flash_kernel, block_k=block_k, causal=causal, scale=scale
+        _flash_kernel, block_k=block_k, causal=causal, scale=scale,
+        masked=masked,
     )
     grid = (b * h, pl.cdiv(s_q, block_q), pl.cdiv(s_k, block_k))
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [qr, kr, vr]
+    if masked:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        operands.append(_lens_per_bh(kv_lens, b, h))
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda i, j, kv: (i, kv, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
                          memory_space=pltpu.VMEM),
@@ -163,18 +190,39 @@ def _flash_forward(q, k, v, *, causal, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr)
+    )(*operands)
     return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
 
 
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_scr, *, block_k: int, causal: bool,
-                         scale: float):
+def _keep_mask(p_shape, q_start, kv_start, kv_len, causal, masked):
+    """The score-keep mask shared by all three kernels (forward and the
+    two backward passes): causal diagonal and/or the padded-key tail —
+    one definition so value and gradient masking cannot diverge."""
+    row = jax.lax.broadcasted_iota(jnp.int32, p_shape, 0)
+    col = jax.lax.broadcasted_iota(jnp.int32, p_shape, 1)
+    keep = None
+    if causal:
+        keep = (q_start + row) >= (kv_start + col)
+    if masked:
+        keep_pad = (kv_start + col) < kv_len
+        keep = keep_pad if keep is None else jnp.logical_and(keep, keep_pad)
+    return keep
+
+
+def _flash_bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
+                         masked: bool):
     """dQ pass: one q-block stays resident while KV blocks stream through
     (kv is the fastest grid axis); dQ accumulates in VMEM scratch and is
     written once on the last kv step.  Recomputes P from (q, k, lse) — the
     block-recompute that keeps backward memory O(S)."""
     from jax.experimental import pallas as pl
+
+    if masked:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
+         dq_ref, dq_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr = refs
+        lens_ref = None
 
     _, block_q, d = q_ref.shape
     kv_idx = pl.program_id(2)
@@ -186,7 +234,10 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros((block_q, d), jnp.float32)
 
+    kv_len = lens_ref[pl.program_id(0)] if masked else None
     live = (q_start + block_q > kv_start) if causal else True
+    if masked:
+        live = jnp.logical_and(live, kv_start < kv_len)
 
     @pl.when(live)
     def _accumulate():
@@ -201,10 +252,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         p = jnp.exp(scores - lse)          # [block_q, block_k]
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-            p = jnp.where((q_start + row) >= (kv_start + col), p, 0.0)
+        keep = _keep_mask(
+            p.shape, q_start, kv_start, kv_len, causal, masked,
+        )
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         dp = jax.lax.dot_general(
             do, vv, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -220,12 +272,19 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_scr, dv_scr, *, block_q: int,
-                          causal: bool, scale: float):
+def _flash_bwd_dkv_kernel(*refs, block_q: int, causal: bool, scale: float,
+                          masked: bool):
     """dK/dV pass: one kv-block stays resident while Q blocks stream through
     (q is the fastest grid axis); dK and dV accumulate in VMEM scratch."""
     from jax.experimental import pallas as pl
+
+    if masked:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, lens_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+        lens_ref = None
 
     _, block_k, d = k_ref.shape
     q_idx = pl.program_id(2)
@@ -238,7 +297,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros((block_k, d), jnp.float32)
         dv_scr[:] = jnp.zeros((block_k, d), jnp.float32)
 
+    kv_len = lens_ref[pl.program_id(0)] if masked else None
     live = (q_start + block_q > kv_start) if causal else True
+    if masked:
+        # A kv block entirely in the padded tail gets zero gradient.
+        live = jnp.logical_and(live, kv_start < kv_len)
 
     @pl.when(live)
     def _accumulate():
@@ -253,10 +316,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         p = jnp.exp(scores - lse)          # [block_q, block_k]
-        if causal:
-            row = jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
-            col = jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-            p = jnp.where((q_start + row) >= (kv_start + col), p, 0.0)
+        keep = _keep_mask(
+            p.shape, q_start, kv_start, kv_len, causal, masked,
+        )
+        if keep is not None:
+            p = jnp.where(keep, p, 0.0)
         dv_scr[:] = dv_scr[:] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -277,8 +341,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
-                    interpret):
+def _flash_backward(q, k, v, kv_lens, out, lse, g, *, causal, scale, block_q,
+                    block_k, interpret):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -296,6 +360,12 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
         axis=-1,
     )
     nq, nkv = pl.cdiv(s_q, block_q), pl.cdiv(s_k, block_k)
+    masked = kv_lens is not None
+    operands = [qr, kr, vr, dor, lser, delta]
+    lens_spec = []
+    if masked:
+        operands.append(_lens_per_bh(kv_lens, b, h))
+        lens_spec = [pl.BlockSpec(memory_space=pltpu.SMEM)]
 
     qspec = pl.BlockSpec((1, block_q, d), lambda i, j, x: (i, j, 0),
                          memory_space=pltpu.VMEM)
@@ -305,15 +375,15 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, masked=masked),
         grid=(b * h, nq, nkv),
         in_specs=[qspec, kvspec_stream, kvspec_stream, qspec, rowspec,
-                  rowspec],
+                  rowspec] + lens_spec,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(*operands)
 
     kvspec = pl.BlockSpec((1, block_k, d), lambda i, j, x: (i, j, 0),
                           memory_space=pltpu.VMEM)
@@ -323,10 +393,10 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
                                   memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, masked=masked),
         grid=(b * h, nkv, nq),
         in_specs=[qspec_stream, kvspec, kvspec, qspec_stream, rowspec_stream,
-                  rowspec_stream],
+                  rowspec_stream] + lens_spec,
         out_specs=[kvspec, kvspec],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_k, d), k.dtype),
@@ -337,7 +407,7 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(qr, kr, vr, dor, lser, delta)
+    )(*operands)
     return (
         dq.reshape(b, h, s_q, d),
         dk.reshape(b, h, s_k, d),
@@ -346,10 +416,11 @@ def _flash_backward(q, k, v, out, lse, g, *, causal, scale, block_q, block_k,
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
 )
 def flash_attention(
     q, k, v,
+    kv_lens=None,
     causal: bool = False,
     scale: Optional[float] = None,
     block_q: int = 128,
@@ -364,34 +435,44 @@ def flash_attention(
     stays O(S) — the [S, S] score matrix is never materialized in either
     direction.  ``interpret=True`` runs the kernels in interpreter mode for
     CPU tests.
+
+    ``kv_lens`` ([B] int, or None) masks the padded key tail per sequence —
+    key/value positions >= kv_lens[b] are dropped from the softmax (the
+    right-padded BERT mask family, fused into the kernel).  Every length
+    must be >= 1.  custom_vjp functions take positional arguments only.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, _ = _flash_forward(
-        q, k, v, causal=causal, scale=scale,
+        q, k, v, kv_lens, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
     return out
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, kv_lens, causal, scale, block_q, block_k, interpret):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, lse = _flash_forward(
-        q, k, v, causal=causal, scale=scale,
+        q, k, v, kv_lens, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, kv_lens, out, lse)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, g):
-    q, k, v, out, lse = res
+    q, k, v, kv_lens, out, lse = res
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_backward(
-        q, k, v, out, lse, g, causal=causal, scale=scale,
+    dq, dk, dv = _flash_backward(
+        q, k, v, kv_lens, out, lse, g, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
+    dlens = (
+        None if kv_lens is None
+        else np.zeros(kv_lens.shape, jax.dtypes.float0)
+    )
+    return dq, dk, dv, dlens
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -414,6 +495,7 @@ def attention(
     *,
     causal: bool = False,
     mask: Optional[jax.Array] = None,
+    kv_lens: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     implementation: str = "auto",
     block_q: int = 128,
@@ -425,9 +507,18 @@ def attention(
     and the XLA path.
 
     ``implementation``: 'auto' | 'xla' | 'flash' | 'ring' | 'ulysses'.
-    Arbitrary masks always take the XLA path (the flash kernel handles the
-    causal mask only); requesting 'flash' with a mask is an error rather
-    than a silent drop.  The flash kernel also requires s_q == s_k — its
+    ARBITRARY masks always take the XLA path (requesting 'flash' with one
+    is an error rather than a silent drop), but the right-padded mask
+    family — ``kv_lens`` [B] valid-key counts, the BERT padding case — is
+    fused into the flash kernel, so padded batches keep the O(S) kernel
+    instead of falling back.  When both ``mask`` and ``kv_lens`` are given
+    they must describe the same thing (callers pass the boolean mask for
+    the XLA fallback and the lengths for the kernel); the flash path uses
+    only ``kv_lens``.  Lengths are clamped to >= 1 on BOTH paths (a
+    zero-length row would divide by an empty softmax in the kernel and
+    produce uniform garbage in the fallback — the clamp makes the two
+    backends agree on attending key 0).  The flash kernel also requires
+    s_q == s_k — its
     causal mask is aligned to the main diagonal, whereas the XLA path uses
     bottom-right alignment for cross-length decode shapes.
 
@@ -440,7 +531,7 @@ def attention(
     """
     if implementation in ("ring", "ulysses"):
         # Shared preconditions for the sequence-parallel strategies.
-        if mask is not None:
+        if mask is not None or kv_lens is not None:
             raise ValueError(
                 f"{implementation} attention supports the causal mask only; "
                 "pass implementation='xla' for arbitrary masks"
@@ -459,11 +550,16 @@ def attention(
         return sp_fn(
             q, k, v, mesh, axis_name=ring_axis, causal=causal, scale=scale
         )
+    if kv_lens is not None:
+        # Contract: every length >= 1 (see docstring); clamp on both
+        # backends so they agree instead of NaN-vs-garbage divergence.
+        kv_lens = jnp.maximum(kv_lens, 1)
     if implementation == "flash":
-        if mask is not None:
+        if mask is not None and kv_lens is None:
             raise ValueError(
-                "flash attention supports the causal mask only; pass "
-                "implementation='xla' (or 'auto') for arbitrary masks"
+                "flash attention supports the causal mask and kv_lens "
+                "right-padding only; pass implementation='xla' (or 'auto') "
+                "for arbitrary masks"
             )
         if q.shape[-2] != k.shape[-2]:
             raise ValueError(
@@ -476,11 +572,21 @@ def attention(
                 f"block sizes (S={q.shape[-2]}, block_q={block_q}, "
                 f"block_k={block_k}); pad the sequence or use the XLA path"
             )
-        return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
+        return flash_attention(
+            q, k, v, kv_lens, causal, scale, block_q, block_k, False
+        )
     if (
         implementation == "auto"
-        and mask is None
+        and (mask is None or kv_lens is not None)
         and _flash_supported(q, k, block_q, block_k)
     ):
-        return flash_attention(q, k, v, causal, scale, block_q, block_k, False)
+        return flash_attention(
+            q, k, v, kv_lens, causal, scale, block_q, block_k, False
+        )
+    if mask is None and kv_lens is not None:
+        # XLA fallback must honor the padding the kernel would have fused.
+        mask = (
+            jnp.arange(k.shape[-2])[None, None, None, :]
+            < kv_lens[:, None, None, None]
+        )
     return dot_product_attention(q, k, v, causal=causal, mask=mask, scale=scale)
