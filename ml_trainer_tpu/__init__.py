@@ -25,6 +25,7 @@ from ml_trainer_tpu.trainer import Trainer
 from ml_trainer_tpu.data import Loader, ArrayDataset, ShardedSampler
 from ml_trainer_tpu.models import MLModel
 from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
+from ml_trainer_tpu.generate import generate
 
 __version__ = "0.1.0"
 
@@ -39,5 +40,6 @@ __all__ = [
     "load_history",
     "load_model",
     "plot_history",
+    "generate",
     "__version__",
 ]

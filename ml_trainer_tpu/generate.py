@@ -1,0 +1,118 @@
+"""Autoregressive generation with a KV cache — TPU-native decode.
+
+The reference has no generation/serving path at all (SURVEY.md §1: "no
+serving layer"); this exists for the GPT-2 north-star family.  Design is
+decode-as-one-program: the model runs in flax ``decode`` mode (each block
+writes K/V into a fixed-size ``cache`` collection — models/layers.py), the
+prompt prefills the cache in ONE batched causal forward (an MXU-friendly
+matmul pass, not P single-token steps), and the sampling loop is one
+``lax.scan``.  Static shapes throughout (the cache is [B, H, max_len, D]
+from step 0), no per-token dispatch, no recompilation as the sequence
+grows — the XLA-friendly shape of incremental decoding.  Compiled programs
+are cached per (model, shape, temperature-mode), so repeat calls pay
+compilation once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Compiled decode programs keyed by (module, batch, prompt_len,
+# max_new_tokens, dtype, greedy) — flax modules are frozen dataclasses,
+# hence hashable keys.
+_COMPILED: dict = {}
+
+
+def generate(
+    model,
+    variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
+
+    ``model`` is a causal LM from the zoo (e.g. ``get_model('gpt2')``)
+    whose module exposes ``decode``/``max_len``; ``variables`` its trained
+    ``{'params': ...}``.  ``temperature=0`` is greedy argmax; otherwise
+    categorical sampling at ``temperature`` (``rng`` seeds it; temperature
+    is traced, so changing it does not recompile).  Returns
+    [B, P + max_new_tokens] token ids.
+    """
+    params = variables["params"] if "params" in variables else variables
+    b, prompt_len = prompt_ids.shape
+    total = prompt_len + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + new tokens ({max_new_tokens}) exceeds "
+            f"the model's max_len ({model.max_len})"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    greedy = temperature == 0.0
+
+    key = (model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy)
+    run = _COMPILED.get(key)
+    if run is None:
+        run = _build(model, b, prompt_ids.dtype, max_new_tokens, greedy)
+        _COMPILED[key] = run
+    return run(params, prompt_ids, jnp.asarray(temperature, jnp.float32), rng)
+
+
+def _build(model, b, dtype, max_new_tokens, greedy):
+    dm = model.clone(decode=True)
+
+    # Cache shapes without running compute: zeros are exactly the cache's
+    # initial state (keys/values empty, indices 0).
+    cache_shapes = jax.eval_shape(
+        lambda p: dm.init(
+            {"params": p}, jnp.zeros((b, 1), dtype), train=False
+        )["cache"],
+        jax.random.PRNGKey(0),
+    )
+
+    def sample(last, temperature, rng, t):
+        if greedy:
+            return jnp.argmax(last, axis=-1).astype(dtype)
+        return jax.random.categorical(
+            jax.random.fold_in(rng, t), last / temperature, axis=-1
+        ).astype(dtype)
+
+    @jax.jit
+    def run(params, prompt_ids, temperature, rng):
+        cache = jax.tree.map(
+            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
+        )
+        # Prefill: the whole prompt through one causal forward, K/V landing
+        # in the cache; its last logits sample the first new token.
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, prompt_ids,
+            train=False, mutable=["cache"],
+        )
+        cache = mut["cache"]
+        tok = sample(logits[:, -1], temperature, rng, 0)[:, None]
+
+        def step(carry, t):
+            cache, tok = carry
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                train=False, mutable=["cache"],
+            )
+            nxt = sample(logits[:, -1], temperature, rng, t)[:, None]
+            return (mut["cache"], nxt), tok
+
+        (_, last_tok), toks = jax.lax.scan(
+            step, (cache, tok), jnp.arange(1, max_new_tokens)
+        )
+        # toks holds tokens 0..n-2 (each step emits its INPUT); append the
+        # final sampled one.
+        new = jnp.concatenate(
+            [jnp.moveaxis(toks[:, :, 0], 0, 1), last_tok], axis=1
+        ) if max_new_tokens > 1 else last_tok
+        return jnp.concatenate([prompt_ids, new], axis=1)
+
+    return run

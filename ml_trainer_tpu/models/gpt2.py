@@ -17,11 +17,15 @@ from ml_trainer_tpu.models.layers import TransformerBlock
 from ml_trainer_tpu.models.registry import register_model
 
 
-def _embed_input(mdl: nn.Module, input_ids):
+def _embed_input(mdl: nn.Module, input_ids, pos_start=None):
     """Shared non-trunk front end for the GPT-2 variants: token embedding +
     learned positions (params ``tok_embed``/``pos_embed`` on ``mdl`` — one
-    definition so GPT2 and GPT2Pipelined cannot drift apart).  Returns the
-    embedded activations and the embed module for head tying."""
+    definition so GPT2, GPT2Pipelined and the decode path cannot drift
+    apart).  ``pos_start`` (traced scalar) offsets the position slice for
+    KV-cached decoding.  Returns the embedded activations and the embed
+    module for head tying."""
+    import jax as _jax
+
     s = input_ids.shape[1]
     tok_embed = nn.Embed(mdl.vocab_size, mdl.embed_dim, name="tok_embed")
     x = tok_embed(input_ids)
@@ -29,7 +33,13 @@ def _embed_input(mdl: nn.Module, input_ids):
         "pos_embed", nn.initializers.normal(0.01),
         (1, mdl.max_len, mdl.embed_dim),
     )
-    return (x + pos[:, :s]).astype(mdl.dtype), tok_embed
+    if pos_start is None:
+        pos_slice = pos[:, :s]
+    else:
+        pos_slice = _jax.lax.dynamic_slice(
+            pos, (0, pos_start, 0), (1, s, mdl.embed_dim)
+        )
+    return (x + pos_slice).astype(mdl.dtype), tok_embed
 
 
 def _tied_head(mdl: nn.Module, x, tok_embed):
@@ -53,10 +63,23 @@ class GPT2(nn.Module):
     moe_experts: int = 0  # >0: MoE feed-forward in every block (EP axis)
     remat: bool = False  # jax.checkpoint each block: O(depth) -> O(1)
     # layer activations live in HBM during backward (long-context lever)
+    decode: bool = False  # KV-cached single-token inference (generate())
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
-        x, tok_embed = _embed_input(self, input_ids)
+        if self.decode:
+            # Positions come from a cached counter so the whole decode
+            # loop (prefill at S=P, then S=1 steps) runs under one
+            # compiled program.
+            pos_idx = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            x, tok_embed = _embed_input(
+                self, input_ids, pos_start=pos_idx.value
+            )
+            pos_idx.value = pos_idx.value + input_ids.shape[1]
+        else:
+            x, tok_embed = _embed_input(self, input_ids)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         # remat: recompute each block's activations in the backward pass
@@ -71,7 +94,9 @@ class GPT2(nn.Module):
                 num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
                 causal=True, dropout_rate=self.dropout_rate, dtype=self.dtype,
                 attention_impl=self.attention_impl, mesh=self.mesh,
-                moe_experts=self.moe_experts, name=f"block{i}",
+                moe_experts=self.moe_experts, decode=self.decode,
+                decode_max_len=self.max_len if self.decode else 0,
+                name=f"block{i}",
             )(x, None, train)
         return _tied_head(self, x, tok_embed)
 

@@ -19,13 +19,23 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from ml_trainer_tpu.ops.attention import attention
 
 
 class MultiHeadAttention(nn.Module):
-    """Self-attention over [B, S, E] with heads split for ops.attention."""
+    """Self-attention over [B, S, E] with heads split for ops.attention.
+
+    ``decode=True`` switches to single-token autoregressive mode (flax's
+    standard cache pattern): each call consumes x of sequence length 1,
+    appends its key/value into a ``cache`` collection ([B, H, L, D] ring
+    written at ``cache_index``) and attends the query against every cached
+    position so far.  The decode loop then runs as one ``lax.scan`` with
+    the cache as carry — no recompilation per step, no growing shapes.
+    ``decode_max_len`` fixes the cache length L (static shapes for XLA).
+    """
 
     num_heads: int
     head_dim: Optional[int] = None
@@ -34,6 +44,8 @@ class MultiHeadAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
     mesh: Optional[object] = None  # jax Mesh, required for 'ring'
+    decode: bool = False
+    decode_max_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
@@ -49,18 +61,67 @@ class MultiHeadAttention(nn.Module):
             b, s, _ = t.shape
             return t.reshape(b, s, self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
-        out = attention(
-            heads(q), heads(k), heads(v),
-            causal=self.causal, mask=mask, kv_lens=kv_lens,
-            implementation=self.attention_impl,
-            mesh=self.mesh,
-        )
+        if self.decode:
+            if mask is not None or kv_lens is not None:
+                raise ValueError(
+                    "decode mode attends the cached prefix; mask/kv_lens "
+                    "are not supported (an error rather than a silent drop)"
+                )
+            out = self._decode_step(heads(q), heads(k), heads(v))
+        else:
+            out = attention(
+                heads(q), heads(k), heads(v),
+                causal=self.causal, mask=mask, kv_lens=kv_lens,
+                implementation=self.attention_impl,
+                mesh=self.mesh,
+            )
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         out = nn.Dense(embed, dtype=self.dtype, name="proj")(out)
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out
+
+    def _decode_step(self, q, k, v):
+        """Cached attention step.  S > 1 is the PREFILL call — the whole
+        prompt runs one ordinary causal attention while its K/V land in
+        the cache (one batched MXU-friendly pass, not P single-token
+        steps); S == 1 is the incremental decode step attending the
+        cached prefix."""
+        b, h, s, d = q.shape
+        L = self.decode_max_len
+        if L <= 0:
+            raise ValueError("decode=True needs decode_max_len > 0")
+        cached_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((b, h, L, d), self.dtype),
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, h, L, d), self.dtype),
+        )
+        idx_var = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = idx_var.value
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(self.dtype), (0, 0, idx, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(self.dtype), (0, 0, idx, 0)
+        )
+        idx_var.value = idx + s
+        if s > 1:
+            # Prefill: plain causal attention over the prompt itself (the
+            # cache starts empty, so nothing earlier exists to attend).
+            return attention(q, k, v, causal=True, implementation="auto")
+        # Attend over the valid prefix only: one [1, L] masked row — the
+        # decode analog of the causal mask.
+        valid = (jnp.arange(L) <= idx)[None, None, None, :]
+        return attention(
+            q, cached_k.value, cached_v.value,
+            causal=False, mask=valid, implementation="xla",
+        )
 
 
 class MLP(nn.Module):
@@ -95,13 +156,16 @@ class TransformerBlock(nn.Module):
     attention_impl: str = "auto"
     mesh: Optional[object] = None
     moe_experts: int = 0  # >0: MoE feed-forward (expert parallelism)
+    decode: bool = False  # KV-cached single-token mode (see MultiHeadAttention)
+    decode_max_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
         attn = lambda y: MultiHeadAttention(
             self.num_heads, causal=self.causal, dropout_rate=self.dropout_rate,
             dtype=self.dtype, attention_impl=self.attention_impl,
-            mesh=self.mesh, name="attn",
+            mesh=self.mesh, decode=self.decode,
+            decode_max_len=self.decode_max_len, name="attn",
         )(y, mask=mask, train=train, kv_lens=kv_lens)
         if self.moe_experts:
             from ml_trainer_tpu.models.moe import MoEMLP
