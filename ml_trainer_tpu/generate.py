@@ -45,6 +45,10 @@ def generate(
     """
     params = variables["params"] if "params" in variables else variables
     b, prompt_len = prompt_ids.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt_ids
     total = prompt_len + max_new_tokens
     if total > model.max_len:
         raise ValueError(

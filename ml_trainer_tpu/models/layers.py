@@ -112,8 +112,12 @@ class MultiHeadAttention(nn.Module):
         )
         idx_var.value = idx + s
         if s > 1:
-            # Prefill: plain causal attention over the prompt itself (the
-            # cache starts empty, so nothing earlier exists to attend).
+            # Prefill: plain causal attention over the prompt itself.  The
+            # contract is an EMPTY cache (generate() guarantees it) — a
+            # warm-cache multi-token call would silently ignore the cached
+            # prefix, so poison the output to NaN instead of being quietly
+            # wrong (the index is traced; a static assert cannot see it).
+            q = jnp.where(idx == 0, q, jnp.nan)
             return attention(q, k, v, causal=True, implementation="auto")
         # Attend over the valid prefix only: one [1, L] masked row — the
         # decode analog of the causal mask.

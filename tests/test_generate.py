@@ -66,3 +66,29 @@ def test_single_token_prompt():
     out = generate(model, variables, ids, max_new_tokens=4)
     ref = _naive_greedy(model, variables, ids, 4)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_zero_new_tokens_returns_prompt():
+    model, variables, ids = _model_and_ids()
+    out = generate(model, variables, ids, max_new_tokens=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ids))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, variables, ids, max_new_tokens=-1)
+
+
+def test_warm_cache_prefill_poisons_not_silently_wrong():
+    """A second multi-token (prefill-style) call on a warm cache cannot be
+    answered correctly by the fast path; it must yield NaN, not plausible
+    garbage."""
+    import jax.numpy as jnp
+
+    model, variables, ids = _model_and_ids()
+    dm = model.clone(decode=True)
+    _, mut = dm.apply(
+        {"params": variables["params"]}, ids, train=False, mutable=["cache"]
+    )
+    logits2, _ = dm.apply(
+        {"params": variables["params"], "cache": mut["cache"]}, ids,
+        train=False, mutable=["cache"],
+    )
+    assert bool(jnp.isnan(logits2).all())

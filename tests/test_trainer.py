@@ -400,3 +400,22 @@ def test_batchnorm_model_trains(tmp_path):
     after = np.asarray(jax.tree.leaves(t.state.batch_stats)[0])
     assert np.isfinite(t.train_losses[0])
     assert not np.allclose(before, after)
+
+
+def test_bf16_mixed_precision_training(tmp_path):
+    """The ViT north-star recipe: bf16 activation compute, f32 params —
+    params must STAY f32 through updates and the trajectory must be
+    finite (BASELINE.json configs[3])."""
+    import jax.numpy as jnp
+
+    ds = SyntheticCIFAR10(size=32, seed=0)
+    t = Trainer(
+        get_model("vit_tiny", num_classes=10, dtype=jnp.bfloat16),
+        datasets=(ds, ds), epochs=1, batch_size=8,
+        model_dir=str(tmp_path), metric="accuracy", optimizer="adamw",
+        lr=1e-3,
+    )
+    t.fit()
+    assert np.isfinite(t.train_losses[0]) and np.isfinite(t.val_losses[0])
+    dtypes = {leaf.dtype for leaf in jax.tree.leaves(t.state.params)}
+    assert dtypes == {jnp.dtype(jnp.float32)}, dtypes
