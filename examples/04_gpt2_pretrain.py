@@ -71,6 +71,20 @@ def main():
     print({k: (v[-1] if isinstance(v, list) else v)
            for k, v in trainer.history.items()})
 
+    # Decode a short continuation with the trained weights — KV-cached,
+    # one compiled program (ml_trainer_tpu.generate).
+    import jax.numpy as jnp
+
+    from ml_trainer_tpu import generate
+
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(
+        get_model(MODEL, **model_kw), {"params": trainer.state.params},
+        prompt, max_new_tokens=16, temperature=0.8,
+        rng=jax.random.PRNGKey(0),
+    )
+    print("sampled continuation:", out[0, prompt.shape[1]:].tolist())
+
 
 if __name__ == "__main__":
     main()

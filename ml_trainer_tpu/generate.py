@@ -21,8 +21,9 @@ import jax
 import jax.numpy as jnp
 
 # Compiled decode programs keyed by (module, batch, prompt_len,
-# max_new_tokens, dtype, greedy) — flax modules are frozen dataclasses,
-# hence hashable keys.
+# max_new_tokens, dtype, greedy, top_k) — flax modules are frozen
+# dataclasses, hence hashable keys.  top_k is static (recompiles);
+# temperature is traced (does not).
 _COMPILED: dict = {}
 
 
@@ -32,6 +33,7 @@ def generate(
     prompt_ids: jax.Array,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: Optional[int] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
@@ -40,13 +42,18 @@ def generate(
     whose module exposes ``decode``/``max_len``; ``variables`` its trained
     ``{'params': ...}``.  ``temperature=0`` is greedy argmax; otherwise
     categorical sampling at ``temperature`` (``rng`` seeds it; temperature
-    is traced, so changing it does not recompile).  Returns
+    is traced, so changing it does not recompile), optionally restricted
+    to the ``top_k`` most probable tokens.  Returns
     [B, P + max_new_tokens] token ids.
     """
     params = variables["params"] if "params" in variables else variables
     b, prompt_len = prompt_ids.shape
     if max_new_tokens < 0:
         raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if top_k is not None and not 0 < top_k <= model.vocab_size:
+        raise ValueError(
+            f"top_k must be in [1, vocab_size={model.vocab_size}], got {top_k}"
+        )
     if max_new_tokens == 0:
         return prompt_ids
     total = prompt_len + max_new_tokens
@@ -59,15 +66,17 @@ def generate(
         rng = jax.random.PRNGKey(0)
     greedy = temperature == 0.0
 
-    key = (model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy)
+    key = (
+        model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy, top_k,
+    )
     run = _COMPILED.get(key)
     if run is None:
-        run = _build(model, b, prompt_ids.dtype, max_new_tokens, greedy)
+        run = _build(model, b, prompt_ids.dtype, max_new_tokens, greedy, top_k)
         _COMPILED[key] = run
     return run(params, prompt_ids, jnp.asarray(temperature, jnp.float32), rng)
 
 
-def _build(model, b, dtype, max_new_tokens, greedy):
+def _build(model, b, dtype, max_new_tokens, greedy, top_k=None):
     dm = model.clone(decode=True)
 
     # Cache shapes without running compute: zeros are exactly the cache's
@@ -82,6 +91,10 @@ def _build(model, b, dtype, max_new_tokens, greedy):
     def sample(last, temperature, rng, t):
         if greedy:
             return jnp.argmax(last, axis=-1).astype(dtype)
+        if top_k is not None:
+            # Keep the k most probable logits; the rest cannot be drawn.
+            kth = jax.lax.top_k(last, top_k)[0][:, -1:]
+            last = jnp.where(last < kth, -jnp.inf, last)
         return jax.random.categorical(
             jax.random.fold_in(rng, t), last / temperature, axis=-1
         ).astype(dtype)

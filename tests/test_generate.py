@@ -92,3 +92,12 @@ def test_warm_cache_prefill_poisons_not_silently_wrong():
         train=False, mutable=["cache"],
     )
     assert bool(jnp.isnan(logits2).all())
+
+
+def test_top_k_sampling_stays_in_top_k():
+    """With top_k=1, sampling at any temperature degenerates to greedy."""
+    model, variables, ids = _model_and_ids(seed=3)
+    out_k1 = generate(model, variables, ids, max_new_tokens=6,
+                      temperature=1.5, top_k=1, rng=jax.random.PRNGKey(3))
+    ref = _naive_greedy(model, variables, ids, 6)
+    np.testing.assert_array_equal(np.asarray(out_k1), np.asarray(ref))
