@@ -79,7 +79,7 @@ def main():
 
     prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
     out = generate(
-        get_model(MODEL, **model_kw), {"params": trainer.state.params},
+        trainer.model, {"params": trainer.state.params},
         prompt, max_new_tokens=16, temperature=0.8,
         rng=jax.random.PRNGKey(0),
     )

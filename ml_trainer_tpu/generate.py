@@ -56,6 +56,11 @@ def generate(
         )
     if max_new_tokens == 0:
         return prompt_ids
+    greedy = temperature == 0.0
+    if greedy:
+        # Greedy ignores top_k; normalize so the compile cache doesn't
+        # build duplicate byte-identical programs per top_k value.
+        top_k = None
     total = prompt_len + max_new_tokens
     if total > model.max_len:
         raise ValueError(
@@ -64,7 +69,6 @@ def generate(
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    greedy = temperature == 0.0
 
     key = (
         model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy, top_k,
