@@ -2,14 +2,15 @@
 
 The reference's committed notebook outputs (01 nb cell-12/16: per-epoch
 loss/accuracy + throughput lines) act as its golden-run record.  Ours is
-``tests/golden/local_run_tpu.json`` — captured by running
-``GOLDEN_OUT=... python examples/01_local_training.py`` on the real TPU
-chip (synthetic CIFAR-10, the zero-egress stand-in).  This test re-runs the
-exact same configuration on the CPU test mesh and asserts the trajectory
-still lands where the committed record says, within tolerances generous
-enough to absorb CPU-vs-TPU numerics but tight enough to catch real
-regressions (broken schedule stepping, loss scaling, seeding, history
-schema).
+captured by ``GOLDEN_OUT=... python examples/01_local_training.py``
+(synthetic CIFAR-10, the zero-egress stand-in): canonically
+``tests/golden/local_run_tpu.json`` from the real chip, with
+``local_run_cpu.json`` as the stand-in record while the TPU tunnel is
+down (the record notes its ``backend``).  This test re-runs the exact
+same configuration on the CPU test mesh and asserts the trajectory still
+lands where the committed record says, within tolerances generous enough
+to absorb CPU-vs-TPU numerics but tight enough to catch real regressions
+(broken schedule stepping, loss scaling, seeding, history schema).
 """
 
 import json
@@ -17,7 +18,15 @@ import os
 
 import pytest
 
-GOLDEN = os.path.join(os.path.dirname(__file__), "golden", "local_run_tpu.json")
+_GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+# The TPU capture is the canonical record; until a tunnel window produces
+# it, the CPU capture (same config/seeds, backend noted inside) keeps the
+# regression net ACTIVE rather than skipped.
+_CANDIDATES = [
+    os.path.join(_GOLDEN_DIR, "local_run_tpu.json"),
+    os.path.join(_GOLDEN_DIR, "local_run_cpu.json"),
+]
+GOLDEN = next((p for p in _CANDIDATES if os.path.exists(p)), _CANDIDATES[0])
 
 HISTORY_KEYS = {
     "epochs", "train_loss", "val_loss", "train_metric", "val_metric",
