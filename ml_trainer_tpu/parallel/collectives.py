@@ -41,11 +41,19 @@ def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
 
 
 def ppermute_ring(x, axis: AxisName, shift: int = 1):
-    """Send each shard to its ring neighbour over ICI — the building block of
-    ring attention / pipelined collectives."""
+    """Send each shard to its ring neighbour over ICI — the building block
+    of ring attention (parallel/ring.py rotates K/V through it)."""
     n = lax.axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
+
+
+def all_to_all(x, axis: AxisName, *, split_axis: int, concat_axis: int):
+    """Re-partition one array dim across another — the Ulysses
+    head/sequence exchange (parallel/ulysses.py runs a pair of these)."""
+    return lax.all_to_all(
+        x, axis, split_axis=split_axis, concat_axis=concat_axis, tiled=True
+    )
 
 
 def axis_index(axis: AxisName):

@@ -21,6 +21,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ml_trainer_tpu.parallel.collectives import ppermute_ring
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
@@ -66,9 +68,8 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
             q_offset, src * s_local, causal, scale,
         )
         # Rotate: send our current block to the next device on the ring.
-        perm = [(d, (d + 1) % n) for d in range(n)]
-        kk = lax.ppermute(kk, axis_name, perm)
-        vv = lax.ppermute(vv, axis_name, perm)
+        kk = ppermute_ring(kk, axis_name)
+        vv = ppermute_ring(vv, axis_name)
         return m, l, o, kk, vv
 
     b, h, _, d = q.shape

@@ -25,7 +25,8 @@ import functools
 from typing import Optional
 
 import jax
-from jax import lax
+
+from ml_trainer_tpu.parallel.collectives import all_to_all
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
@@ -34,12 +35,10 @@ def _ulysses_local(q, k, v, *, axis_name, causal, scale, attend):
     """Per-shard body.  q/k/v: [B, H, S_local, D] -> same shape."""
     # Scatter heads, gather sequence: [B, H, S/n, D] -> [B, H/n, S, D].
     def a2a_fwd(x):
-        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
-                              tiled=True)
+        return all_to_all(x, axis_name, split_axis=1, concat_axis=2)
 
     def a2a_bwd(x):
-        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
-                              tiled=True)
+        return all_to_all(x, axis_name, split_axis=2, concat_axis=1)
 
     qg, kg, vg = a2a_fwd(q), a2a_fwd(k), a2a_fwd(v)
     # Full sequence present locally: plain causal attention, no offsets.
