@@ -421,6 +421,7 @@ def main():
     parser.add_argument("--extended", action="store_true",
                         help="also bench the north-star model zoo")
     parser.add_argument("--one", metavar="MODEL", default=None,
+                        choices=sorted(EXTENDED_CONFIGS),
                         help="bench a single north-star model, print one "
                         "JSON line (used by --extended's subprocesses)")
     parser.add_argument("--cpu", action="store_true",
