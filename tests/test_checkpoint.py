@@ -97,3 +97,36 @@ def test_large_state_streams_per_leaf(tmp_path):
     total = sum(os.path.getsize(os.path.join(path, f)) for f in leaves)
     biggest = max(os.path.getsize(os.path.join(path, f)) for f in leaves)
     assert biggest < total  # genuinely split across files
+
+
+def test_crashed_inflight_write_never_shadows_last_good(tmp_path):
+    """Failure-recovery contract: a write that died mid-flight (its .tmp
+    dir never renamed) is invisible to latest_checkpoint, restore uses the
+    last COMPLETE checkpoint, and a clean retry of the same epoch replaces
+    the debris."""
+    state = make_state()
+    good = ckpt.save_checkpoint(
+        str(tmp_path), state, {"train_loss": [1.0], "metric_type": None},
+        epoch=1,
+    )
+    ckpt.wait_for_checkpoints()
+    # Simulate the crash: a partially-written epoch-2 tmp dir (some leaves
+    # on disk, no manifest rename).
+    debris = os.path.join(str(tmp_path), ckpt.CHECKPOINT_PREFIX + "2.tmp")
+    os.makedirs(debris)
+    with open(os.path.join(debris, "leaf_000.npy"), "wb") as f:
+        f.write(b"\x93NUMPY garbage")
+    assert ckpt.latest_checkpoint(str(tmp_path)) == good
+    restored, h, epoch = ckpt.restore_checkpoint(
+        ckpt.latest_checkpoint(str(tmp_path)), make_state(seed=9)
+    )
+    assert epoch == 1
+    assert_states_equal(state, restored)
+    # Retrying the crashed epoch cleans the debris and lands atomically.
+    path2 = ckpt.save_checkpoint(
+        str(tmp_path), state, {"train_loss": [1.0, 0.7], "metric_type": None},
+        epoch=2,
+    )
+    ckpt.wait_for_checkpoints()
+    assert not os.path.exists(debris)
+    assert ckpt.latest_checkpoint(str(tmp_path)) == path2
