@@ -366,3 +366,14 @@ def test_test_keeps_sharded_state_sharded(tmp_path):
     loader = Loader(ds, batch_size=8)
     loss = t.test(None, loader)
     assert np.isfinite(loss)
+
+
+def test_graft_entry_contract():
+    """entry() must return a jittable forward and example args whose
+    traced output is the flagship LM's [B, S, vocab] logits."""
+    import __graft_entry__ as graft
+
+    fn, args = graft.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (1, 128, 50257), out.shape
+    assert out.dtype == jnp.float32
