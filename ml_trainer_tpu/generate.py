@@ -80,17 +80,136 @@ def generate(
     return run(params, prompt_ids, jnp.asarray(temperature, jnp.float32), rng)
 
 
-def _build(model, b, dtype, max_new_tokens, greedy, top_k=None):
-    dm = model.clone(decode=True)
+def beam_search(
+    model,
+    variables: dict,
+    prompt_ids: jax.Array,
+    max_new_tokens: int,
+    num_beams: int = 4,
+) -> jax.Array:
+    """Fixed-horizon beam search: the ``num_beams`` highest-scoring
+    continuations are kept at every step and the best final sequence is
+    returned ([B, P + max_new_tokens]).
 
-    # Cache shapes without running compute: zeros are exactly the cache's
-    # initial state (keys/values empty, indices 0).
-    cache_shapes = jax.eval_shape(
+    Beams fold into the batch dim of the SAME cached decode program
+    ``generate`` uses: one prefill at batch B, the cache tiled to
+    B·num_beams, then a ``lax.scan`` whose carry holds (cache, scores,
+    sequences) — beam reordering is a gather on the cache's batch axis.
+    No EOS semantics (the zoo's synthetic vocabularies have none): all
+    beams run the full horizon, so scores compare equal-length sequences
+    and no length penalty is needed.
+    """
+    params = variables["params"] if "params" in variables else variables
+    b, prompt_len = prompt_ids.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if not 0 < num_beams <= model.vocab_size:
+        raise ValueError(
+            f"num_beams must be in [1, vocab_size={model.vocab_size}], "
+            f"got {num_beams}"
+        )
+    if prompt_len + max_new_tokens > model.max_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + new tokens ({max_new_tokens}) exceeds "
+            f"the model's max_len ({model.max_len})"
+        )
+    key = ("beam", model, b, prompt_len, max_new_tokens,
+           prompt_ids.dtype, num_beams)
+    run = _COMPILED.get(key)
+    if run is None:
+        run = _build_beam(model, b, prompt_ids.dtype, max_new_tokens,
+                          num_beams)
+        _COMPILED[key] = run
+    return run(params, prompt_ids)
+
+
+def _cache_shapes(dm, b, dtype):
+    """Cache pytree shapes without compute — zeros are exactly the cache's
+    initial state (keys/values empty, indices 0)."""
+    return jax.eval_shape(
         lambda p: dm.init(
             {"params": p}, jnp.zeros((b, 1), dtype), train=False
         )["cache"],
         jax.random.PRNGKey(0),
     )
+
+
+def _empty_cache(cache_shapes):
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes)
+
+
+def _build_beam(model, b, dtype, max_new_tokens, k):
+    dm = model.clone(decode=True)
+    cache_shapes = _cache_shapes(dm, b, dtype)
+
+    def tile_beams(leaf):
+        # [B, ...] -> [B*K, ...]; scalar counters replicate as-is.
+        if getattr(leaf, "ndim", 0) == 0:
+            return leaf
+        return jnp.repeat(leaf, k, axis=0)
+
+    @jax.jit
+    def run(params, prompt_ids):
+        cache = _empty_cache(cache_shapes)
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, prompt_ids,
+            train=False, mutable=["cache"],
+        )
+        cache = jax.tree.map(tile_beams, mut["cache"])
+        logprobs0 = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        # Step 0: all beams share the prefill state, so rank the first
+        # tokens directly — top-k over the vocab seeds the beams.
+        scores, tok0 = jax.lax.top_k(logprobs0, k)      # [B, K]
+        seqs0 = jnp.zeros((b, k, max_new_tokens), dtype)
+        seqs0 = seqs0.at[:, :, 0].set(tok0.astype(dtype))
+
+        def step(carry, t):
+            cache, scores, seqs = carry
+            tok = jax.lax.dynamic_index_in_dim(
+                seqs, t - 1, axis=2, keepdims=False
+            ).reshape(b * k, 1)
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                train=False, mutable=["cache"],
+            )
+            cache = mut["cache"]
+            logprobs = jax.nn.log_softmax(
+                logits[:, -1].astype(jnp.float32)
+            ).reshape(b, k, -1)
+            vocab = logprobs.shape[-1]
+            total = scores[:, :, None] + logprobs          # [B, K, V]
+            scores, flat_idx = jax.lax.top_k(
+                total.reshape(b, k * vocab), k
+            )                                              # [B, K]
+            beam_idx = flat_idx // vocab                   # [B, K]
+            tok_idx = (flat_idx % vocab).astype(dtype)
+            # Reorder surviving beams: sequences and the cache batch axis.
+            seqs = jnp.take_along_axis(seqs, beam_idx[:, :, None], axis=1)
+            seqs = seqs.at[:, :, t].set(tok_idx)
+            flat_gather = (
+                jnp.arange(b)[:, None] * k + beam_idx
+            ).reshape(-1)                                  # [B*K]
+            cache = jax.tree.map(
+                lambda l: l[flat_gather] if getattr(l, "ndim", 0) else l,
+                cache,
+            )
+            return (cache, scores, seqs), None
+
+        (cache, scores, seqs), _ = jax.lax.scan(
+            step, (cache, scores, seqs0), jnp.arange(1, max_new_tokens)
+        )
+        best = jnp.argmax(scores, axis=-1)                 # [B]
+        best_seq = jnp.take_along_axis(
+            seqs, best[:, None, None], axis=1
+        )[:, 0]                                            # [B, N]
+        return jnp.concatenate([prompt_ids, best_seq], axis=1)
+
+    return run
+
+
+def _build(model, b, dtype, max_new_tokens, greedy, top_k=None):
+    dm = model.clone(decode=True)
+    cache_shapes = _cache_shapes(dm, b, dtype)
 
     def sample(last, temperature, rng, t):
         if greedy:
@@ -105,9 +224,7 @@ def _build(model, b, dtype, max_new_tokens, greedy, top_k=None):
 
     @jax.jit
     def run(params, prompt_ids, temperature, rng):
-        cache = jax.tree.map(
-            lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
-        )
+        cache = _empty_cache(cache_shapes)
         # Prefill: the whole prompt through one causal forward, K/V landing
         # in the cache; its last logits sample the first new token.
         logits, mut = dm.apply(
