@@ -198,11 +198,8 @@ def _build_beam(model, b, dtype, max_new_tokens, k):
         (cache, scores, seqs), _ = jax.lax.scan(
             step, (cache, scores, seqs0), jnp.arange(1, max_new_tokens)
         )
-        best = jnp.argmax(scores, axis=-1)                 # [B]
-        best_seq = jnp.take_along_axis(
-            seqs, best[:, None, None], axis=1
-        )[:, 0]                                            # [B, N]
-        return jnp.concatenate([prompt_ids, best_seq], axis=1)
+        # top_k returns scores sorted descending, so beam 0 is the winner.
+        return jnp.concatenate([prompt_ids, seqs[:, 0]], axis=1)
 
     return run
 

@@ -142,7 +142,6 @@ def test_beam_search_scores_at_least_greedy():
     """With several beams the returned sequence's log-probability should
     beat or match greedy's (not a theorem, but holds on this fixed seed —
     the point is beams explore beyond the greedy path)."""
-    import jax.numpy as jnp  # noqa: F811
     from ml_trainer_tpu.generate import beam_search
 
     model, variables, ids = _model_and_ids(seed=11, b=1, p=4)
