@@ -89,6 +89,8 @@ def main(args) -> None:
         is_parallel=args.is_parallel,
         save_history=True,
         steps_per_execution=args.steps_per_execution,
+        grad_accum_steps=args.grad_accum_steps,
+        shard_opt_state=args.shard_opt_state,
         grad_clip_norm=args.grad_clip_norm,
         ema_decay=args.ema_decay,
         **config,
@@ -158,6 +160,12 @@ def parse_args(argv=None):
                         help="optimizer steps per device dispatch "
                              "(lax.scan inside one compiled program; "
                              "trajectory identical, dispatch amortized)")
+    parser.add_argument("--grad_accum_steps", type=int, default=1,
+                        help="microbatches per optimizer update (compiled "
+                        "scan — the GPT-2 large-batch lever)")
+    parser.add_argument("--shard_opt_state", action="store_true",
+                        help="ZeRO-1 placement: partition optimizer moments "
+                        "over the data mesh axis")
     parser.add_argument("--grad_clip_norm", type=float, default=None,
                         help="clip gradients to this global L2 norm "
                              "before the optimizer update")
