@@ -250,10 +250,16 @@ def bench_one_model(name: str) -> dict:
     from ml_trainer_tpu.ops import get_criterion, get_optimizer
     from ml_trainer_tpu.train_state import TrainState
 
+    def progress(msg):
+        # One line per phase so a per-model TIMEOUT in bench_extended can
+        # report WHERE the tunnel wedged (its error keeps the last line).
+        print(f"# {name}: {msg}", file=sys.stderr, flush=True)
+
     bf16 = jnp.bfloat16
     shape, kind, make_kw = EXTENDED_CONFIGS[name]
     model = get_model(name, **make_kw())
     rng = np.random.default_rng(0)
+    progress("transferring inputs to device")
     if kind == "image":
         x = jnp.asarray(rng.normal(size=shape), bf16)
         y = jnp.asarray(rng.integers(0, 10, shape[0]), jnp.int32)
@@ -264,6 +270,8 @@ def bench_one_model(name: str) -> dict:
             if kind == "lm"
             else jnp.asarray(rng.integers(0, 2, shape[0]), jnp.int32)
         )
+    jax.block_until_ready((x, y))
+    progress("inputs on device; compiling init")
 
     t_c = time.time()
     variables = jax.jit(model.init, static_argnames="train")(
@@ -328,6 +336,10 @@ def bench_one_model(name: str) -> dict:
         "model": name, "batch_shape": list(shape),
         "samples_per_sec": round(rate * shape[0], 1),
         "mfu": round(mfu, 4) if mfu is not None else None,
+        # mfu can be null on a healthy TPU run (cost analysis unavailable),
+        # so the row records the backend explicitly — recovery's done-check
+        # must not confuse a CPU-fallback row with a TPU measurement.
+        "backend": jax.default_backend(),
     }
 
 
@@ -427,6 +439,9 @@ def main():
     parser.add_argument("--cpu", action="store_true",
                         help="pin the CPU backend (in-process config update "
                         "— the only pin that survives sitecustomize)")
+    parser.add_argument("--loaders", action="store_true",
+                        help="run only the host input-pipeline benchmark "
+                        "(Python vs C++ loader; no device work)")
     parser.add_argument("--reconcile", action="store_true",
                         help="measure BOTH dispatch paths (per-batch and "
                         "multi-step) in one session with the fenced timer "
@@ -437,6 +452,11 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     if args.one:
         print(json.dumps(bench_one_model(args.one)), flush=True)
+        return
+    if args.loaders:
+        # Host-side only: measures the input pipeline, touches no device,
+        # so it is safe (and meaningful) while the TPU tunnel is down.
+        bench_loaders()
         return
     record = {
         "metric": (

@@ -73,6 +73,12 @@ from ml_trainer_tpu.utils.utils import LoadedModel
 
 logger = get_logger("ml_trainer_tpu.trainer")
 
+# Set when a Trainer(backend='cpu') pinned the host platform: the pin is
+# process-wide and irreversible once the backend initializes, so a later
+# Trainer(backend='tpu') in the same process must be told it is NOT on
+# the chip (jax gives it the CPU backend with no error of its own).
+_CPU_PLATFORM_PINNED = False
+
 
 def enable_compilation_cache(path: str = "/tmp/ml_trainer_tpu_jax_cache") -> None:
     """Persistent XLA compilation cache, shared across processes.
@@ -202,6 +208,31 @@ class Trainer:
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
         self.config = cfg
+        if cfg.backend == "cpu":
+            # The gloo-analog host fallback (ref: main.py:73) must actually
+            # select the host platform: environments that pin a TPU platform
+            # at interpreter startup (sitecustomize) would otherwise dial
+            # the chip for a run the user explicitly routed to CPU.  The
+            # update only takes effect if the backend has not initialized
+            # yet (it does NOT raise afterwards), so verify the platform
+            # that actually came up and surface a silent no-op.
+            global _CPU_PLATFORM_PINNED
+            jax.config.update("jax_platforms", "cpu")
+            if jax.default_backend() != "cpu":
+                logger.warning(
+                    "backend='cpu' requested after the JAX backend "
+                    f"initialized; keeping '{jax.default_backend()}'."
+                )
+            else:
+                _CPU_PLATFORM_PINNED = True
+        elif _CPU_PLATFORM_PINNED:
+            # Don't force backend init just to check — the flag already
+            # proves a cpu pin took effect earlier in this process.
+            logger.warning(
+                f"backend='{cfg.backend}' requested, but an earlier "
+                "Trainer(backend='cpu') pinned the host platform for this "
+                "process; this run will execute on CPU."
+            )
         # Parity attribute names (ref: src/trainer.py:30-41).
         self.epochs = epochs
         self.scheduler_type = cfg.scheduler

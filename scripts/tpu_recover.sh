@@ -1,0 +1,152 @@
+#!/bin/bash
+# Resume-aware TPU session: run ONLY the runbook stages whose artifacts
+# are still missing.  A wedged tunnel mid-session (2026-07-30: one session
+# delivered the headline + ResNet-50 rows, then hung every later stage)
+# costs only the stages it interrupted — re-runs pick up from there.
+# Safe to re-run any number of times.
+#
+#   tpu_recover.sh          run the missing stages (probes the TPU first)
+#   tpu_recover.sh --check  exit 0 iff every stage would skip (no device
+#                           touch; the watcher's completeness test)
+#
+# A stage that hits its timeout aborts the whole pass (exit 2): on this
+# tunnel a timeout means the session is wedged, and every later stage
+# would burn its full timeout against a dead chip.  The watcher re-probes
+# and retries on the next cycle.
+#
+# The driver-facing `python bench.py` / `--extended` paths (single
+# parseable JSON record incl. TIMEOUT rows) are unchanged — this script
+# is the artifact-recovery path, not the driver contract.
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+OUT=/tmp/tpu_runbook
+mkdir -p "$OUT" tests/golden
+
+# --- skip conditions, one function per stage -------------------------------
+# The headline is done iff the reconcile record holds BOTH dispatch paths:
+# bench.py writes per_batch_samples_per_sec into the record before the
+# multi-step pass (deliberately, so a hang cannot lose it), so that key
+# alone does NOT mean the session finished — require a numeric "value"
+# (only set after the multi-step pass) too.  A "note" key marks a
+# CPU-fallback or CPU-pinned record (bench.py sets it in exactly those
+# cases) — those numbers must not stand in for the TPU headline.
+headline_done() {
+  grep -q '"per_batch_samples_per_sec"' "$OUT/bench_headline.out" 2>/dev/null \
+    && grep -q '"value": [0-9]' "$OUT/bench_headline.out" \
+    && ! grep -q '"note"' "$OUT/bench_headline.out"
+}
+loaders_done() {
+  grep -q 'input pipeline native' "$OUT/loaders.out" 2>/dev/null
+}
+# Row-anchored ([^}]* cannot cross the row's closing brace, so a later
+# model's keys cannot vouch for an earlier TIMEOUT row in the single-line
+# --extended record) and TPU-proven: a numeric "mfu" (old-format rows) or
+# an explicit "backend": "tpu" (rows since the backend key was added —
+# mfu alone is not enough, it is legitimately null when XLA cost analysis
+# is unavailable, and absent on CPU-fallback rows).
+model_done() {
+  grep -hqE "\"model\": \"$1\", \"batch_shape\": [^}]*(\"mfu\": [0-9]|\"backend\": \"tpu\")" \
+    "$OUT"/bench_extended.out "$OUT"/one_*.out 2>/dev/null
+}
+golden_done() {
+  python - <<'EOF' 2>/dev/null
+import json, sys
+rec = json.load(open("tests/golden/local_run_tpu.json"))
+sys.exit(0 if rec.get("backend") == "tpu" else 1)
+EOF
+}
+flash_done() {
+  python - <<'EOF' 2>/dev/null
+import json, sys
+rec = json.load(open("docs/flash_tpu_validation.json"))
+sys.exit(0 if rec.get("all_pass") and "TPU" in rec.get("device", "") else 1)
+EOF
+}
+# The guard cell's OUTPUT records the device list of the backend that ran
+# ('[TPU v5 lite0]' on the chip).  Plain 'TPU' also matches the notebooks'
+# own prose ('TPU-native', ...), so anchor on the device-repr prefix.
+notebook_done() {
+  f=$(ls notebooks/$1_*.ipynb 2>/dev/null | head -1)
+  [ -n "$f" ] && grep -q 'TPU v' "$f"
+}
+
+if [ "${1:-}" = "--check" ]; then
+  headline_done || exit 1
+  loaders_done || exit 1
+  for m in resnet50 vit_b16 bert_base gpt2; do model_done "$m" || exit 1; done
+  golden_done || exit 1
+  flash_done || exit 1
+  notebook_done 01 || exit 1
+  notebook_done 03 || exit 1
+  exit 0
+fi
+
+# run_stage <secs> <outfile> <cmd...>: run under timeout, tee the tail to
+# the console, abort the pass on a stage timeout (wedged tunnel).
+run_stage() {
+  secs=$1; outfile=$2; shift 2
+  timeout "$secs" "$@" > "$outfile" 2>&1
+  rc=$?
+  tail -12 "$outfile"
+  if [ "$rc" -eq 124 ]; then
+    echo "== stage timed out (${secs}s) — tunnel wedged, aborting pass =="
+    exit 2
+  fi
+  return "$rc"
+}
+
+echo "== probe =="
+timeout 240 python -u -c "import jax; print(jax.devices())" || {
+  echo "TPU unavailable; aborting recovery"; exit 1; }
+
+if headline_done; then
+  echo "== 1. headline bench: already recorded, skipping =="
+else
+  echo "== 1. headline bench (reconcile) =="
+  BENCH_WATCHDOG_SECS=1500 \
+    run_stage 1700 "$OUT/bench_headline.out" python bench.py --reconcile
+fi
+
+if loaders_done; then
+  echo "== 1b. loader bench: already recorded, skipping =="
+else
+  echo "== 1b. host input-pipeline bench (no device work) =="
+  run_stage 900 "$OUT/loaders.out" python bench.py --loaders --cpu
+fi
+
+for m in resnet50 vit_b16 bert_base gpt2; do
+  if model_done "$m"; then
+    echo "== 2. $m: already measured, skipping =="
+    continue
+  fi
+  echo "== 2. $m =="
+  run_stage 600 "$OUT/one_$m.out" python bench.py --one "$m" || true
+done
+
+if golden_done; then
+  echo "== 3. golden: TPU record already committed, skipping =="
+else
+  echo "== 3. golden-run capture =="
+  GOLDEN_OUT=tests/golden/local_run_tpu.json MODEL_DIR=/tmp/golden_model \
+    run_stage 1800 "$OUT/golden.out" python examples/01_local_training.py
+fi
+
+if flash_done; then
+  echo "== 4. flash validation: already recorded, skipping =="
+else
+  echo "== 4. flash-attention TPU validation =="
+  run_stage 1800 "$OUT/flash.out" python scripts/validate_flash_tpu.py
+fi
+
+for nb in 01 03; do
+  if notebook_done "$nb"; then
+    echo "== 5. notebook $nb: TPU-executed copy committed, skipping =="
+    continue
+  fi
+  echo "== 5. notebook $nb =="
+  MODEL_DIR=model_output \
+    run_stage 1800 "$OUT/nb$nb.out" python scripts/make_notebooks.py --only "$nb"
+done
+
+echo "== recovery pass done =="

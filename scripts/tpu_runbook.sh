@@ -1,42 +1,8 @@
 #!/bin/bash
 # Serialized TPU session: everything this repo needs from the (single,
 # flaky) TPU chip, one process at a time — concurrent clients wedge the
-# remote tunnel.  Each stage logs to /tmp/tpu_runbook/.
-set -u
-cd "$(dirname "$0")/.."
-# examples/ and scripts/ import the package from the repo root; running
-# them as `python examples/01_...py` puts examples/ (not the root) on
-# sys.path, so export the root explicitly.
-export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
-OUT=/tmp/tpu_runbook
-mkdir -p "$OUT" tests/golden
-
-echo "== probe =="
-timeout 240 python -u -c "import jax; print(jax.devices())" || {
-  echo "TPU unavailable; aborting runbook"; exit 1; }
-
-echo "== 1. headline bench (per-batch vs multi-step reconciliation) =="
-# In-process watchdog BELOW the shell timeout so a hang still emits the
-# safety JSON line before SIGTERM (the driver needs a parseable record).
-BENCH_WATCHDOG_SECS=1500 timeout 1700 \
-  python bench.py --reconcile | tee "$OUT/bench_headline.out"
-
-echo "== 2. extended bench (budgeted) =="
-BENCH_WATCHDOG_SECS=2800 EXTENDED_BUDGET_SECS=1800 timeout 3000 \
-  python bench.py --extended 2>&1 | tee "$OUT/bench_extended.out"
-
-echo "== 3. golden-run capture =="
-GOLDEN_OUT=tests/golden/local_run_tpu.json MODEL_DIR=/tmp/golden_model \
-  timeout 1800 python examples/01_local_training.py 2>&1 | tail -5 \
-  | tee "$OUT/golden.out"
-
-echo "== 4. flash-attention TPU validation =="
-timeout 1800 python scripts/validate_flash_tpu.py 2>&1 | tail -8 \
-  | tee "$OUT/flash.out"
-
-echo "== 5. notebooks 01 + 03 (executed on TPU) =="
-MODEL_DIR=model_output timeout 1800 python scripts/make_notebooks.py --only 01 \
-  | tee "$OUT/nb01.out"
-timeout 900 python scripts/make_notebooks.py --only 03 | tee "$OUT/nb03.out"
-
-echo "== runbook done =="
+# remote tunnel.  Stage commands and completion checks live in
+# tpu_recover.sh (resume-aware: a fresh environment runs every stage, a
+# wedged-session retry runs only what is still missing); this wrapper
+# exists because the runbook name is the documented entry point.
+exec bash "$(dirname "$0")/tpu_recover.sh" "$@"

@@ -1,0 +1,38 @@
+#!/bin/bash
+# Tunnel-recovery watcher: wait for any in-flight runbook/recovery to
+# finish (the tunnel serializes — concurrent clients wedge it), then
+# probe the TPU every few minutes and run a resume-aware recovery pass
+# (tpu_recover.sh) each time the probe answers.  Exits when
+# `tpu_recover.sh --check` reports every artifact present, or after
+# MAX_HOURS.
+set -u
+cd "$(dirname "$0")/.."
+MAX_HOURS=${MAX_HOURS:-10}
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+LOG=/tmp/tpu_watch.log
+
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  # Never dial while another client owns the tunnel.
+  # (the watcher's own --check / recovery calls run sequentially after
+  # this pgrep, never concurrently with it, so matching tpu_recover.sh
+  # here only catches a manually launched recovery — which is the point.
+  # Patterns are anchored to interpreter invocations so an editor or grep
+  # with one of these filenames in its argv does not park the watcher.)
+  if pgrep -f "python[0-9.]* ([^ ]*/)?(bench\.py|validate_flash_tpu\.py|make_notebooks\.py|01_local_training\.py)|bash ([^ ]*/)?(tpu_runbook\.sh|tpu_recover\.sh)$" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) busy: another TPU client running" >> "$LOG"
+    sleep 300
+    continue
+  fi
+  if bash scripts/tpu_recover.sh --check; then
+    echo "$(date -u +%H:%M:%S) all artifacts present — watcher done" >> "$LOG"
+    exit 0
+  fi
+  if timeout 180 python -u -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) probe OK — running recovery pass" >> "$LOG"
+    bash scripts/tpu_recover.sh >> "$LOG" 2>&1
+  else
+    echo "$(date -u +%H:%M:%S) probe failed" >> "$LOG"
+  fi
+  sleep 300
+done
+echo "$(date -u +%H:%M:%S) deadline reached" >> "$LOG"
