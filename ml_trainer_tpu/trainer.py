@@ -217,13 +217,18 @@ class Trainer:
             # yet (it does NOT raise afterwards), so verify the platform
             # that actually came up and surface a silent no-op.
             global _CPU_PLATFORM_PINNED
+            prev = getattr(jax.config, "jax_platforms", None)
             jax.config.update("jax_platforms", "cpu")
             if jax.default_backend() != "cpu":
                 logger.warning(
                     "backend='cpu' requested after the JAX backend "
                     f"initialized; keeping '{jax.default_backend()}'."
                 )
-            else:
+            elif prev != "cpu":
+                # Only remember pins that actually changed the platform
+                # selection: when the process was already pinned to CPU
+                # (tests, CPU-only hosts pinning it themselves) a later
+                # backend='tpu' Trainer should not be blamed for it.
                 _CPU_PLATFORM_PINNED = True
         elif _CPU_PLATFORM_PINNED:
             # Don't force backend init just to check — the flag already

@@ -93,6 +93,13 @@ run_stage() {
     echo "== stage timed out (${secs}s) — tunnel wedged, aborting pass =="
     exit 2
   fi
+  # bench.py's in-process watchdog converts a hang into exit(1) + an error
+  # JSON (it fires BELOW the shell timeout so the record still lands) —
+  # that is the same wedged-tunnel signal as rc 124.
+  if grep -q '"error": "watchdog' "$outfile" 2>/dev/null; then
+    echo "== stage hit its in-process watchdog — tunnel wedged, aborting pass =="
+    exit 2
+  fi
   return "$rc"
 }
 
