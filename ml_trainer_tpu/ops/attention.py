@@ -490,6 +490,58 @@ def _flash_supported(q, k, block_q, block_k) -> bool:
     )
 
 
+# In 'auto' mode the padded-flash path only engages from this sequence
+# length up: padding to the next block multiple costs up to
+# (ceil(S/128)*128 / S)^2 extra score FLOPs, which at short S can hand
+# back more than flash saves, while the XLA path's materialized [S, S]
+# scores are still cheap there.  From ~1K tokens the O(S) memory and
+# fused-softmax wins dominate.  Explicit implementation='flash' pads at
+# any length.
+_AUTO_PAD_MIN_SEQ = 1024
+
+
+def _flash_padded(q, k, v, kv_lens, causal, scale, block_q, block_k,
+                  interpret=False):
+    """Run the flash kernel on shapes it cannot take directly, by padding.
+
+    * head_dim -> next multiple of 64: zero-padding q and k adds zero
+      terms to every score (q·k over the padded lanes), and zero-padding
+      v makes the extra output lanes exact zeros — both sliced off, so
+      the result is bit-equivalent math, not an approximation.
+    * seq -> next multiple of lcm(block_q, block_k): padded KEYS are
+      masked via the kernel's fused ``kv_lens`` right-padding (so they
+      contribute nothing forward and get zero dK/dV); padded QUERY rows
+      compute values that are sliced off, and their output cotangent is
+      zero under the slice's VJP, so ds for those rows vanishes and they
+      contribute nothing to dQ/dK/dV either.
+
+    Requires s_q == s_k (the kernel's causal mask is diagonal-aligned);
+    ``scale`` is resolved against the ORIGINAL head_dim before padding.
+    """
+    import math
+
+    b, h, s, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    block = math.lcm(block_q, block_k)
+    s_pad = -(-s // block) * block
+    d_pad = -(-d // 64) * 64
+    pad = ((0, 0), (0, 0), (0, s_pad - s), (0, d_pad - d))
+    qp, kp, vp = (jnp.pad(t, pad) for t in (q, k, v))
+    if kv_lens is None and s_pad == s:
+        # Head-dim-only padding adds no masked keys — keep the unmasked
+        # kernel variant (no SMEM lens operand, no per-block keep mask).
+        lens = None
+    elif kv_lens is None:
+        lens = jnp.full((b,), s, jnp.int32)
+    else:
+        lens = jnp.minimum(kv_lens.astype(jnp.int32), s)
+    out = flash_attention(
+        qp, kp, vp, lens, causal, scale, block_q, block_k, interpret
+    )
+    return out[..., :s, :d]
+
+
 def attention(
     q, k, v,
     *,
@@ -521,6 +573,13 @@ def attention(
     s_q == s_k — its
     causal mask is aligned to the main diagonal, whereas the XLA path uses
     bottom-right alignment for cross-length decode shapes.
+
+    Off-tile shapes (sequence not divisible by the block sizes, head_dim
+    not a multiple of 64) run the kernel through ``_flash_padded`` —
+    exact math via zero-padding plus the fused kv_lens mask, at the cost
+    of the padded block's extra FLOPs.  'flash' pads whenever needed;
+    'auto' pads only from ``_AUTO_PAD_MIN_SEQ`` tokens up, where the
+    O(S) memory win dominates, and otherwise falls back to XLA.
 
     'ring' runs sequence-parallel ring attention (parallel.ring) over
     ``mesh[ring_axis]`` — K/V shards rotate around the ICI ring while each
@@ -566,23 +625,34 @@ def attention(
                 "flash attention requires equal query/key lengths "
                 f"(got {q.shape[-2]} vs {k.shape[-2]}); use the XLA path"
             )
-        if q.shape[-2] % block_q or k.shape[-2] % block_k:
-            raise ValueError(
-                f"flash attention requires sequence lengths divisible by the "
-                f"block sizes (S={q.shape[-2]}, block_q={block_q}, "
-                f"block_k={block_k}); pad the sequence or use the XLA path"
+        if (
+            q.shape[-2] % block_q
+            or k.shape[-2] % block_k
+            or q.shape[-1] % 64
+        ):
+            # Off-tile shapes run through the padding wrapper — exact
+            # math (see _flash_padded), slightly more FLOPs.
+            return _flash_padded(
+                q, k, v, kv_lens, causal, scale, block_q, block_k
             )
         return flash_attention(
             q, k, v, kv_lens, causal, scale, block_q, block_k, False
         )
-    if (
-        implementation == "auto"
-        and (mask is None or kv_lens is not None)
-        and _flash_supported(q, k, block_q, block_k)
-    ):
-        return flash_attention(
-            q, k, v, kv_lens, causal, scale, block_q, block_k, False
-        )
+    if implementation == "auto" and (mask is None or kv_lens is not None):
+        if _flash_supported(q, k, block_q, block_k):
+            return flash_attention(
+                q, k, v, kv_lens, causal, scale, block_q, block_k, False
+            )
+        if (
+            jax.default_backend() == "tpu"
+            and q.shape[-2] == k.shape[-2]
+            and q.shape[-2] >= _AUTO_PAD_MIN_SEQ
+        ):
+            # Long off-tile sequences: the O(S) memory win beats the
+            # padding overhead (see _AUTO_PAD_MIN_SEQ rationale).
+            return _flash_padded(
+                q, k, v, kv_lens, causal, scale, block_q, block_k
+            )
     if mask is None and kv_lens is not None:
         # XLA fallback must honor the padding the kernel would have fused.
         mask = (

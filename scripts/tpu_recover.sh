@@ -128,7 +128,11 @@ for m in resnet50 vit_b16 bert_base gpt2; do
     continue
   fi
   echo "== 2. $m =="
-  run_stage 600 "$OUT/one_$m.out" python bench.py --one "$m" || true
+  # --assume-up: this pass's own probe just ran; bench.py's pre-probe
+  # would both duplicate the init and convert a wedged-tunnel hang into
+  # a swallowed exit 1 instead of the rc-124 timeout that aborts the pass.
+  run_stage 600 "$OUT/one_$m.out" python bench.py --one "$m" --assume-up \
+    || true
 done
 
 if golden_done; then

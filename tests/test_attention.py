@@ -262,3 +262,108 @@ def test_flash_inside_shard_map_matches_dense():
     out = jax.jit(fn)(q, k, v)
     ref = dot_product_attention(q, k, v, causal=True)
     np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_padded_off_tile_shapes_match_reference(causal):
+    """VERDICT r2 weak #7 (remaining half): off-tile shapes — a ViT-like
+    sequence (197) and a head_dim that is not a multiple of 64 — run the
+    kernel through the zero-padding wrapper with exact-math results."""
+    from ml_trainer_tpu.ops.attention import _flash_padded
+
+    q, k, v = qkv(b=2, h=2, s=197, d=48, seed=8)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    out = _flash_padded(q, k, v, None, causal, None, 128, 128, interpret=True)
+    assert out.shape == q.shape
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_padded_respects_kv_lens():
+    from ml_trainer_tpu.ops.attention import _flash_padded
+
+    s = 100
+    q, k, v = qkv(b=2, h=2, s=s, d=32, seed=9)
+    kv_lens = jnp.asarray([s, 37], jnp.int32)
+    mask = (jnp.arange(s)[None, None, None, :] < kv_lens[:, None, None, None])
+    ref = dot_product_attention(q, k, v, mask=mask)
+    out = _flash_padded(q, k, v, kv_lens, False, None, 128, 128,
+                        interpret=True)
+    np.testing.assert_allclose(out, ref, atol=2e-3, rtol=2e-3)
+
+
+def test_flash_padded_gradients_match_reference():
+    """Padded query rows receive zero cotangent through the slice VJP and
+    padded keys are masked, so gradients must equal the dense reference
+    on the real region — and carry no NaNs from the padding."""
+    from ml_trainer_tpu.ops.attention import _flash_padded
+
+    q, k, v = qkv(b=1, h=2, s=77, d=40, seed=10)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            _flash_padded(q, k, v, None, True, None, 64, 64,
+                          interpret=True) ** 2
+        )
+
+    def loss_ref(q, k, v):
+        return jnp.sum(dot_product_attention(q, k, v, causal=True) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        assert np.isfinite(np.asarray(a)).all(), f"d{name} has non-finite"
+        np.testing.assert_allclose(a, b_, atol=2e-3, rtol=2e-3,
+                                   err_msg=f"d{name}")
+
+
+def test_auto_dispatch_pads_only_long_off_tile_sequences(monkeypatch):
+    """'auto' takes: exact flash on tile-aligned shapes, the padding
+    wrapper only from _AUTO_PAD_MIN_SEQ up, XLA below it."""
+    import ml_trainer_tpu.ops.attention as A
+
+    calls = []
+
+    def fake_flash(q, k, v, kv_lens, causal, scale, block_q, block_k,
+                   interpret):
+        calls.append("exact")
+        return dot_product_attention(q, k, v, causal=causal)
+
+    def fake_padded(q, k, v, kv_lens, causal, scale, block_q, block_k,
+                    interpret=False):
+        calls.append("padded")
+        return dot_product_attention(q, k, v, causal=causal)
+
+    monkeypatch.setattr(A.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(A, "flash_attention", fake_flash)
+    monkeypatch.setattr(A, "_flash_padded", fake_padded)
+
+    q, k, v = qkv(b=1, h=1, s=256, d=64, seed=11)
+    A.attention(q, k, v, causal=True)               # tile-aligned
+    q2, k2, v2 = qkv(b=1, h=1, s=1100, d=64, seed=11)
+    A.attention(q2, k2, v2, causal=True)            # long off-tile
+    q3, k3, v3 = qkv(b=1, h=1, s=197, d=64, seed=11)
+    out = A.attention(q3, k3, v3, causal=True)      # short off-tile -> XLA
+    assert calls == ["exact", "padded"]
+    np.testing.assert_allclose(
+        out, dot_product_attention(q3, k3, v3, causal=True), atol=1e-5
+    )
+
+
+def test_flash_padded_head_dim_only_keeps_unmasked_variant():
+    """d-only padding must not fabricate a lens array (the masked kernel
+    variant costs an SMEM operand + per-block keep mask for nothing)."""
+    from unittest import mock
+
+    import ml_trainer_tpu.ops.attention as A
+
+    q, k, v = qkv(b=1, h=1, s=128, d=48, seed=12)
+    with mock.patch.object(
+        A, "flash_attention", wraps=A.flash_attention
+    ) as spy:
+        out = A._flash_padded(q, k, v, None, True, None, 64, 64,
+                              interpret=True)
+    assert spy.call_args[0][3] is None  # kv_lens stayed None
+    np.testing.assert_allclose(
+        out, dot_product_attention(q, k, v, causal=True),
+        atol=2e-3, rtol=2e-3,
+    )
