@@ -61,6 +61,7 @@ class GPT2(nn.Module):
     attention_impl: str = "auto"
     mesh: object = None  # jax Mesh; needed for attention_impl='ring'
     moe_experts: int = 0  # >0: MoE feed-forward in every block (EP axis)
+    moe_top_k: int = 1    # experts per token (1 = Switch, 2 = GShard)
     remat: bool = False  # jax.checkpoint each block: O(depth) -> O(1)
     # layer activations live in HBM during backward (long-context lever)
     decode: bool = False  # KV-cached single-token inference (generate())
@@ -94,7 +95,8 @@ class GPT2(nn.Module):
                 num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
                 causal=True, dropout_rate=self.dropout_rate, dtype=self.dtype,
                 attention_impl=self.attention_impl, mesh=self.mesh,
-                moe_experts=self.moe_experts, decode=self.decode,
+                moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
+                decode=self.decode,
                 decode_max_len=self.max_len if self.decode else 0,
                 name=f"block{i}",
             )(x, None, train)

@@ -160,6 +160,7 @@ class TransformerBlock(nn.Module):
     attention_impl: str = "auto"
     mesh: Optional[object] = None
     moe_experts: int = 0  # >0: MoE feed-forward (expert parallelism)
+    moe_top_k: int = 1    # experts per token (1 = Switch, 2 = GShard)
     decode: bool = False  # KV-cached single-token mode (see MultiHeadAttention)
     decode_max_len: int = 0
 
@@ -175,7 +176,8 @@ class TransformerBlock(nn.Module):
             from ml_trainer_tpu.models.moe import MoEMLP
 
             mlp = lambda y: MoEMLP(
-                self.moe_experts, self.mlp_dim, dtype=self.dtype, name="mlp",
+                self.moe_experts, self.mlp_dim,
+                num_selected=self.moe_top_k, dtype=self.dtype, name="mlp",
             )(y, train=train)
         else:
             mlp = lambda y: MLP(

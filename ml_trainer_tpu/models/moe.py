@@ -9,8 +9,11 @@ over a capacity-bounded one-hot dispatch tensor — no gather/scatter, no
 data-dependent shapes, so XLA lowers the whole layer onto the MXU and turns
 the expert-axis shardings into the dispatch all-to-alls.
 
-Top-1 routing (Switch-Transformer style) with capacity factor + auxiliary
-load-balance loss (reported via ``self.sow`` so trainers can add it).
+Top-1 routing (Switch-Transformer style) by default; ``num_selected=2``
+gives GShard-style top-2 with renormalized gates and priority dispatch
+(all first choices claim capacity before any second choice).  Capacity
+factor + auxiliary load-balance loss (reported via ``self.sow`` so
+trainers can add it) apply to both.
 """
 
 from __future__ import annotations
@@ -26,14 +29,19 @@ class MoEMLP(nn.Module):
     """Drop-in replacement for the dense transformer MLP block.
 
     x: [B, S, M] -> [B, S, M]; E experts each an (M -> hidden -> M) MLP.
-    Tokens route to their top-1 expert, bounded by
-    ``capacity = ceil(capacity_factor * tokens / E)`` per expert; overflow
-    tokens fall through the residual (output 0 for the MLP branch).
+    Tokens route to their top-``num_selected`` experts, bounded by
+    ``capacity = floor(capacity_factor * tokens * num_selected / E)``
+    (min 1) per expert; overflow tokens fall through the residual
+    (output 0 for the MLP branch).  With ``num_selected > 1`` gates renormalize over the
+    selected experts (GShard) — at 1 the raw router probability is the
+    gate (Switch), so the default reproduces the original behavior
+    exactly.
     """
 
     num_experts: int
     hidden_dim: int
     capacity_factor: float = 1.25
+    num_selected: int = 1
     dtype: jnp.dtype = jnp.float32
     activation: Callable = nn.gelu
 
@@ -41,35 +49,58 @@ class MoEMLP(nn.Module):
     def __call__(self, x, train: bool = False):
         b, s, m = x.shape
         e = self.num_experts
+        kk = self.num_selected
+        if not 1 <= kk <= e:
+            raise ValueError(
+                f"num_selected must be in [1, num_experts={e}], got {kk}"
+            )
         tokens = b * s
-        capacity = max(int(self.capacity_factor * tokens / e), 1)
+        capacity = max(int(self.capacity_factor * tokens * kk / e), 1)
         xt = x.reshape(tokens, m)
 
         # Router (always f32 — small matmul, numerics matter).
         router = nn.Dense(e, dtype=jnp.float32, name="router")
         probs = jax.nn.softmax(router(xt.astype(jnp.float32)), axis=-1)
 
-        expert_idx = jnp.argmax(probs, axis=-1)                # [T]
-        expert_mask = jax.nn.one_hot(expert_idx, e)            # [T, E]
-        gate = jnp.sum(probs * expert_mask, axis=-1)           # [T]
+        topk_probs, topk_idx = jax.lax.top_k(probs, kk)        # [T, K]
+        masks = jax.nn.one_hot(topk_idx, e)                    # [T, K, E]
+        gates = (
+            topk_probs if kk == 1
+            else topk_probs
+            / jnp.sum(topk_probs, axis=-1, keepdims=True)
+        )                                                      # [T, K]
 
-        # Switch-Transformer load-balance loss: E * sum(fraction * prob).
-        fraction = jnp.mean(expert_mask, axis=0)
+        # Switch/GShard load-balance loss: E * sum(fraction * prob), with
+        # the token fraction taken over FIRST choices (both papers').
+        fraction = jnp.mean(masks[:, 0], axis=0)
         prob_mean = jnp.mean(probs, axis=0)
         self.sow(
             "losses", "moe_aux_loss",
             e * jnp.sum(fraction * prob_mean),
         )
 
-        # Position of each token within its expert's capacity buffer;
-        # tokens past capacity are dropped (residual passes them through).
-        position = jnp.cumsum(expert_mask, axis=0) * expert_mask - 1.0
-        keep = (position < capacity) & (expert_mask > 0)        # [T, E]
-        onehot_pos = jax.nn.one_hot(
-            jnp.clip(position, 0, capacity - 1).astype(jnp.int32), capacity
-        )                                                       # [T, E, C]
-        dispatch = onehot_pos * keep[..., None]                 # [T, E, C]
-        combine = dispatch * gate[:, None, None]                # [T, E, C]
+        # Position of each token within its expert's capacity buffer,
+        # priority-ordered: every first choice claims a slot before any
+        # second choice (GShard's dispatch order); tokens past capacity
+        # are dropped (residual passes them through).  K is static so
+        # this unrolls into K cumsums.
+        dispatch = jnp.zeros((tokens, e, capacity), jnp.float32)
+        combine = jnp.zeros((tokens, e, capacity), jnp.float32)
+        claimed = jnp.zeros((e,), jnp.float32)
+        for sel in range(kk):
+            mask_s = masks[:, sel]                              # [T, E]
+            position = (
+                jnp.cumsum(mask_s, axis=0) - 1.0 + claimed[None, :]
+            ) * mask_s
+            keep = (position < capacity) & (mask_s > 0)         # [T, E]
+            onehot_pos = jax.nn.one_hot(
+                jnp.clip(position, 0, capacity - 1).astype(jnp.int32),
+                capacity,
+            )                                                   # [T, E, C]
+            slot = onehot_pos * keep[..., None]                 # [T, E, C]
+            dispatch = dispatch + slot
+            combine = combine + slot * gates[:, sel][:, None, None]
+            claimed = claimed + jnp.sum(mask_s, axis=0)
 
         # Stacked expert weights, sharded over the expert mesh axis by the
         # EP_RULES PartitionSpecs (parallel/tp_rules.py).
