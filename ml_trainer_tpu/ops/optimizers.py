@@ -57,12 +57,28 @@ def _adamw(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
     return optax.adamw(lr, weight_decay=weight_decay)
 
 
+def _lamb(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
+    # LAMB (layerwise-adaptive Adam): the large-batch TPU recipe used for
+    # BERT pretraining — decoupled decay like adamw, per-layer trust ratio.
+    return optax.lamb(lr, weight_decay=weight_decay)
+
+
+def _lion(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
+    # Lion: sign-momentum optimizer; one moment buffer instead of Adam's
+    # two — 2x less optimizer HBM for the big-model configs.
+    return optax.lion(lr, weight_decay=weight_decay)
+
+
+# The first five names are the reference set (ref: src/trainer.py:123-138);
+# lamb/lion extend it for the north-star large-batch/large-model configs.
 OPTIMIZERS = {
     "sgd": _sgd,
     "adam": _adam,
     "adagrad": _adagrad,
     "adamax": _adamax,
     "adamw": _adamw,
+    "lamb": _lamb,
+    "lion": _lion,
 }
 
 
@@ -74,7 +90,8 @@ def get_optimizer(
 ) -> optax.GradientTransformation:
     """Map an optimizer name to an optax transformation.
 
-    Same name set as ref: src/trainer.py:123-138.  Unknown names raise
+    The reference's five names (ref: src/trainer.py:123-138) plus
+    ``lamb``/``lion`` for the north-star configs.  Unknown names raise
     ``ValueError`` (the reference silently returns ``None`` — a latent bug we
     do not replicate).
     """

@@ -12,7 +12,12 @@ compute the way DDP's bucketed reducer overlaps it, but fused by the XLA
 latency-hiding scheduler rather than hand-written buckets).
 """
 
-from ml_trainer_tpu.parallel.mesh import create_mesh, default_mesh, mesh_shape_for
+from ml_trainer_tpu.parallel.mesh import (
+    create_hybrid_mesh,
+    create_mesh,
+    default_mesh,
+    mesh_shape_for,
+)
 from ml_trainer_tpu.parallel.distributed import (
     initialize_distributed,
     process_count,
@@ -48,6 +53,7 @@ __all__ = [
     "FSDP_RULES",
     "TRANSFORMER_TP_RULES",
     "rules_for",
+    "create_hybrid_mesh",
     "create_mesh",
     "default_mesh",
     "mesh_shape_for",

@@ -65,3 +65,76 @@ def create_mesh(
 
 def default_mesh() -> Mesh:
     return create_mesh()
+
+
+def _split_dcn(axes, dims, dcn_axes, num_slices):
+    """Factor the slice count out of the mesh dims.
+
+    The slice count lands on the FIRST (outermost) dcn axis divisible by
+    it; that axis keeps its intra-slice remainder on ICI — e.g. 2 slices
+    x 16 chips with axes data=8, tensor=4 becomes dcn data=2, ici
+    data=4, ici tensor=4.  (mesh_utils requires prod(dcn_mesh_shape) ==
+    num_slices exactly.)  Returns (ici_dims, dcn_dims), elementwise
+    product == dims."""
+    ici, dcn = [], []
+    slices_left = num_slices
+    for a, size in zip(axes, dims):
+        if a in dcn_axes and slices_left > 1 and size % slices_left == 0:
+            dcn.append(slices_left)
+            ici.append(size // slices_left)
+            slices_left = 1
+        else:
+            dcn.append(1)
+            ici.append(size)
+    if slices_left > 1:
+        raise ValueError(
+            f"mesh dims {dict(zip(axes, dims))} cannot span {num_slices} "
+            f"slices: no axis in dcn_axes={tuple(dcn_axes)} is divisible "
+            "by the slice count"
+        )
+    return ici, dcn
+
+
+def create_hybrid_mesh(
+    shape: Dict[str, int],
+    *,
+    dcn_axes: Sequence[str] = ("data",),
+) -> Mesh:
+    """Multi-slice mesh: DCN-spanning axes get whole slices, ICI axes stay
+    inside a slice.
+
+    On a multi-slice TPU deployment (N pods joined over data-center
+    network), collectives on an axis that crosses slice boundaries run at
+    DCN bandwidth — orders of magnitude below ICI.  ``create_mesh``'s
+    host-major reshape already tends that way, but only
+    ``mesh_utils.create_hybrid_device_mesh`` consults the real slice
+    topology (it groups devices by ``slice_index``).  ``dcn_axes`` names
+    the axes allowed to cross slices (default: data parallelism — the
+    standard multi-slice recipe: gradient all-reduce tolerates DCN
+    latency, tensor/sequence/expert collectives do not).
+
+    Single-slice processes (including the CPU-simulated mesh, which has
+    no slice_index) fall back to ``create_mesh`` — same axes, same
+    semantics, so code written against the hybrid helper rehearses
+    unchanged on the test mesh.
+    """
+    devices = jax.devices()
+    num_slices = len({getattr(d, "slice_index", 0) for d in devices})
+    if num_slices <= 1:
+        return create_mesh(shape, devices)
+    from jax.experimental import mesh_utils
+
+    axes = [a for a in AXIS_ORDER if shape.get(a, 1) > 1] or ["data"]
+    dims = [shape.get(a, 1) for a in axes]
+    ici, dcn = _split_dcn(axes, dims, dcn_axes, num_slices)
+    mesh_devices = mesh_utils.create_hybrid_device_mesh(
+        ici, dcn, devices=devices,
+    )
+    if list(mesh_devices.shape) != dims:
+        # Never reshape here: a raw C-order reshape would scramble the
+        # slice-aware placement this function exists to produce.
+        raise ValueError(
+            f"hybrid device mesh came back {mesh_devices.shape}, "
+            f"expected {tuple(dims)}"
+        )
+    return Mesh(mesh_devices, axis_names=tuple(axes))

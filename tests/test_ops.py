@@ -19,7 +19,10 @@ from ml_trainer_tpu.ops import (
 
 
 # --------------------------------------------------------------- optimizers
-@pytest.mark.parametrize("name", ["sgd", "adam", "adagrad", "adamax", "adamw"])
+@pytest.mark.parametrize(
+    "name",
+    ["sgd", "adam", "adagrad", "adamax", "adamw", "lamb", "lion"],
+)
 def test_optimizer_step_changes_params(name):
     tx = get_optimizer(name, 0.1, momentum=0.9, weight_decay=0.01)
     params = {"w": jnp.ones((3,))}
@@ -52,7 +55,21 @@ def test_sgd_matches_torch_semantics():
 
 def test_unknown_optimizer_raises():
     with pytest.raises(ValueError):
-        get_optimizer("lion", 0.1)
+        get_optimizer("rmspropp", 0.1)
+
+
+def test_lion_uses_single_moment_buffer():
+    """The reason lion is in the registry: half the optimizer HBM of the
+    Adam family (one sign-momentum buffer, no second moment)."""
+    params = {"w": jnp.ones((4,))}
+    count = lambda tree: sum(
+        int(np.prod(x.shape))
+        for x in jax.tree.leaves(tree)
+        if hasattr(x, "shape") and x.shape
+    )
+    lion_state = get_optimizer("lion", 0.1).init(params)
+    adam_state = get_optimizer("adamw", 0.1).init(params)
+    assert count(lion_state) == count(adam_state) // 2
 
 
 # ---------------------------------------------------------------- schedules
