@@ -31,19 +31,55 @@ from typing import Callable, Optional
 
 import jax.numpy as jnp
 
-SCHEDULERS = ("CosineAnnealingWarmRestarts", "ReduceLROnPlateau", "StepLR")
+SCHEDULERS = (
+    "CosineAnnealingWarmRestarts", "ReduceLROnPlateau", "StepLR",
+    "WarmupCosine", "WarmupLinear",
+)
 
 
 def make_lr_schedule(
     scheduler_type: Optional[str],
     base_lr: float,
     steps_per_epoch: int,
+    total_steps: Optional[int] = None,
 ) -> Callable:
-    """Build lr(step).  ``scheduler_type=None`` -> constant (ref default)."""
+    """Build lr(step).  ``scheduler_type=None`` -> constant (ref default).
+
+    The first three names mirror the reference registry; ``WarmupCosine``
+    (linear warmup -> cosine decay to ~0, the ViT/GPT pretraining
+    standard) and ``WarmupLinear`` (linear warmup -> linear decay, the
+    BERT fine-tuning standard) extend it for the north-star recipes.
+    Both warm up over 5% of ``total_steps`` (min 1 step) and decay over
+    the remainder; without ``total_steps`` they assume a 100-epoch
+    horizon with a 1-epoch warmup.
+    """
     steps_per_epoch = max(int(steps_per_epoch), 1)
 
     if scheduler_type is None:
         return lambda step: jnp.asarray(base_lr, dtype=jnp.float32)
+
+    if scheduler_type in ("WarmupCosine", "WarmupLinear"):
+        import optax
+
+        if total_steps is None:
+            warmup = steps_per_epoch
+            horizon = 100 * steps_per_epoch
+        else:
+            horizon = max(int(total_steps), 2)
+            warmup = max(horizon // 20, 1)
+        if scheduler_type == "WarmupCosine":
+            return optax.warmup_cosine_decay_schedule(
+                0.0, base_lr, warmup, horizon, end_value=0.0
+            )
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, base_lr, warmup),
+                optax.linear_schedule(
+                    base_lr, 0.0, max(horizon - warmup, 1)
+                ),
+            ],
+            boundaries=[warmup],
+        )
 
     if scheduler_type == "CosineAnnealingWarmRestarts":
         t0_epochs = 5.0

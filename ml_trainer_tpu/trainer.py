@@ -496,7 +496,12 @@ class Trainer:
 
         self.steps_per_epoch = len(self.train_loader)
         self.lr_schedule = make_lr_schedule(
-            cfg.scheduler, cfg.lr, self.steps_per_epoch
+            cfg.scheduler, cfg.lr, self.steps_per_epoch,
+            # epochs may be None (eval-only Trainer): the warmup schedules
+            # then fall back to their documented fixed horizon.
+            total_steps=(
+                self.steps_per_epoch * self.epochs if self.epochs else None
+            ),
         )
         self.tx = get_optimizer(
             cfg.optimizer, self.lr_schedule, cfg.momentum, cfg.weight_decay

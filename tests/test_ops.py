@@ -182,3 +182,24 @@ def test_prediction_functions():
         fn = get_prediction_function(name)
         assert int(get_predictions(x, fn)[0]) == 1
     assert get_prediction_function(None) is None
+
+
+def test_warmup_cosine_schedule():
+    sched = make_lr_schedule("WarmupCosine", 1.0, 10, total_steps=200)
+    # 5% warmup = 10 steps: linear ramp, peak at the boundary, ~0 at end.
+    assert float(sched(0)) == 0.0
+    assert np.isclose(float(sched(5)), 0.5, atol=0.06)
+    assert np.isclose(float(sched(10)), 1.0, atol=1e-6)
+    assert float(sched(200)) < 1e-6
+    # Monotone decay after warmup.
+    vals = [float(sched(s)) for s in range(10, 201, 10)]
+    assert all(a >= b for a, b in zip(vals, vals[1:]))
+
+
+def test_warmup_linear_schedule():
+    sched = make_lr_schedule("WarmupLinear", 2.0, 10, total_steps=100)
+    assert float(sched(0)) == 0.0
+    assert np.isclose(float(sched(5)), 2.0, atol=1e-6)  # warmup=5 steps
+    mid = float(sched(52))  # ~halfway through the 95-step decay
+    assert 0.9 < mid < 1.1
+    assert float(sched(100)) < 1e-6
