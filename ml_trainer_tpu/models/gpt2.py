@@ -42,11 +42,30 @@ def _embed_input(mdl: nn.Module, input_ids, pos_start=None):
     return (x + pos_slice).astype(mdl.dtype), tok_embed
 
 
-def _tied_head(mdl: nn.Module, x, tok_embed):
+def _tied_head(mdl: nn.Module, x, tok_embed, targets=None):
     """Shared back end: final LayerNorm + weight-tied LM head (logits =
     h @ tok_embedᵀ — halves embedding memory, the published GPT-2
-    arrangement)."""
+    arrangement).  With ``targets`` (and ``mdl.loss_chunk`` set) it
+    returns the chunked LM loss instead — one LayerNorm definition for
+    both paths, so the 'ln_final' parameter cannot diverge."""
     x = nn.LayerNorm(dtype=mdl.dtype, name="ln_final")(x)
+    if targets is not None:
+        # Model-computed loss: the [B, S, V] logits tensor (the memory
+        # hot spot — ~0.8 GB for the 124M config at bs=8) is never
+        # materialized; see ops.losses.chunked_lm_cross_entropy.  The
+        # Trainer drives this path for models that accept ``targets``
+        # (metric must be None — there are no logits to score).
+        if not getattr(mdl, "loss_chunk", 0):
+            raise ValueError(
+                "targets requires loss_chunk > 0 (set loss_chunk to a "
+                "divisor of the sequence length to enable the chunked "
+                "LM loss)"
+            )
+        from ml_trainer_tpu.ops.losses import chunked_lm_cross_entropy
+
+        return chunked_lm_cross_entropy(
+            x, tok_embed.embedding, targets, mdl.loss_chunk
+        )
     return x.astype(jnp.float32) @ tok_embed.embedding.T.astype(jnp.float32)
 
 
@@ -65,9 +84,10 @@ class GPT2(nn.Module):
     remat: bool = False  # jax.checkpoint each block: O(depth) -> O(1)
     # layer activations live in HBM during backward (long-context lever)
     decode: bool = False  # KV-cached single-token inference (generate())
+    loss_chunk: int = 0  # >0: with targets, chunked LM loss (see __call__)
 
     @nn.compact
-    def __call__(self, input_ids, train: bool = False):
+    def __call__(self, input_ids, train: bool = False, targets=None):
         if self.decode:
             # Positions come from a cached counter so the whole decode
             # loop (prefill at S=P, then S=1 steps) runs under one
@@ -100,7 +120,7 @@ class GPT2(nn.Module):
                 decode_max_len=self.max_len if self.decode else 0,
                 name=f"block{i}",
             )(x, None, train)
-        return _tied_head(self, x, tok_embed)
+        return _tied_head(self, x, tok_embed, targets)
 
 
 @register_model("gpt2")

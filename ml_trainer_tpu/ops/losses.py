@@ -18,8 +18,10 @@ from __future__ import annotations
 
 from typing import Callable
 
+import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 
 from ml_trainer_tpu.utils.functions import custom_loss_function
 
@@ -49,6 +51,52 @@ def l2_loss(outputs, targets):
     """Mean squared error (``torch.nn.MSELoss``, ref: src/trainer.py:147-148,
     fixed to be an instance)."""
     return jnp.mean(jnp.square(outputs - targets))
+
+
+def chunked_lm_cross_entropy(hidden, embedding, targets, chunk_size=128):
+    """LM cross entropy WITHOUT materializing the [B, S, V] logits tensor.
+
+    The logits of a tied-head language model are the memory hot spot of
+    training: GPT-2 124M at [8, 1024, 50257] is ~0.8 GB of bf16 logits
+    (plus the f32 softmax intermediates the backward keeps).  This
+    computes ``mean(xent(h @ E^T, targets))`` by a ``lax.scan`` over
+    sequence chunks with ``jax.checkpoint`` around the body, so both
+    forward and backward only ever hold one [B, chunk, V] logits block —
+    peak memory drops by S/chunk at the cost of recomputing each block's
+    matmul once in the backward (the flash-attention trade applied to
+    the LM head).
+
+    hidden: [B, S, D] (any float dtype; logits accumulate in f32),
+    embedding: [V, D] (the tied token-embedding matrix), targets: [B, S]
+    int labels.  S must divide by ``chunk_size`` (pick a divisor — the
+    caller knows its sequence length statically).
+    """
+    b, s, d = hidden.shape
+    if s % chunk_size:
+        raise ValueError(
+            f"sequence length {s} not divisible by chunk_size {chunk_size}"
+        )
+    n = s // chunk_size
+    h_chunks = hidden.reshape(b, n, chunk_size, d).swapaxes(0, 1)
+    t_chunks = targets.reshape(b, n, chunk_size).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def body(total, chunk):
+        h_c, t_c = chunk
+        logits = jnp.einsum(
+            "bcd,vd->bcv", h_c.astype(jnp.float32),
+            embedding.astype(jnp.float32),
+        )
+        return (
+            total
+            + optax.softmax_cross_entropy_with_integer_labels(
+                logits, t_c
+            ).sum(),
+            None,
+        )
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (h_chunks, t_chunks))
+    return total / (b * s)
 
 
 CRITERIA = {

@@ -132,6 +132,24 @@ def _module_takes_train(module) -> bool:
         return False
 
 
+def _module_takes_targets(module) -> bool:
+    """Models that accept ``targets`` compute their own loss (e.g. GPT2's
+    chunked LM head, which never materializes the logits tensor); the
+    Trainer then feeds labels through the forward instead of applying the
+    criterion to returned logits."""
+    import inspect
+
+    try:
+        params = inspect.signature(module.__call__).parameters
+    except (TypeError, ValueError):
+        return False
+    # Only engage for models that OPT IN to the self-loss path: accepting
+    # the argument is not enough (a model might take targets for teacher
+    # forcing and still return logits) — it must carry an active
+    # ``loss_chunk`` attribute (GPT2: loss_chunk > 0).
+    return "targets" in params and bool(getattr(module, "loss_chunk", 0))
+
+
 class Trainer:
     def __init__(
         self,
@@ -266,6 +284,7 @@ class Trainer:
             model = get_model(model)
         self.model = model
         self._takes_train = _module_takes_train(model)
+        self._takes_targets = _module_takes_targets(model)
 
         logger.info("Loading the model.")
         self._sharding_rules = sharding_rules
@@ -328,6 +347,12 @@ class Trainer:
         self.criterion = get_criterion(cfg.criterion)
         self.pred_function = get_prediction_function(cfg.pred_function)
         self.metric_fn = get_metric(cfg.metric, self.pred_function)
+        if self._takes_targets and self.metric_fn is not None:
+            raise ValueError(
+                "metric must be None for models that compute their own "
+                "loss (the forward returns a scalar, not logits to score); "
+                f"got metric={cfg.metric!r}"
+            )
 
         self.state: Optional[TrainState] = None
         self.train_loader: Optional[Loader] = None
@@ -444,10 +469,13 @@ class Trainer:
         )
 
     # ----------------------------------------------------------------- state
-    def _apply(self, variables, x, train: bool, rngs=None, mutable=False):
+    def _apply(self, variables, x, train: bool, rngs=None, mutable=False,
+               targets=None):
         kwargs = {}
         if self._takes_train:
             kwargs["train"] = train
+        if targets is not None:
+            kwargs["targets"] = targets
         if mutable:
             if not isinstance(mutable, (list, tuple)):
                 raise TypeError(
@@ -630,6 +658,7 @@ class Trainer:
     def _make_train_step(self):
         criterion, metric_fn, tx = self.criterion, self.metric_fn, self.tx
         has_bs, model_apply = self._has_batch_stats, self._apply
+        takes_targets = self._takes_targets
         has_aux = getattr(self, "_has_aux_losses", False)
         aux_weight = self.moe_aux_weight
         accum = self.grad_accum_steps
@@ -643,19 +672,24 @@ class Trainer:
                 mutable_cols = (["batch_stats"] if has_bs else []) + (
                     ["losses"] if has_aux else []
                 )
+                # Self-loss models (GPT2 chunked LM head): labels go
+                # through the forward, the output IS the loss.
+                fwd_targets = y if takes_targets else None
                 if mutable_cols:
                     out, mutated = model_apply(
                         variables, x, train=True,
                         rngs={"dropout": dropout_rng}, mutable=mutable_cols,
+                        targets=fwd_targets,
                     )
                     new_bs = mutated.get("batch_stats", batch_stats)
                 else:
                     out = model_apply(
-                        variables, x, train=True, rngs={"dropout": dropout_rng}
+                        variables, x, train=True,
+                        rngs={"dropout": dropout_rng}, targets=fwd_targets,
                     )
                     mutated = {}
                     new_bs = batch_stats
-                loss = criterion(out, y)
+                loss = out if takes_targets else criterion(out, y)
                 if has_aux:
                     # Sown auxiliary losses (e.g. MoE load-balance,
                     # models/moe.py): summed over layers, scaled once.
@@ -736,9 +770,24 @@ class Trainer:
         trainer state is touched (test() builds steps for foreign modules
         through this too)."""
         criterion, metric_fn = self.criterion, self.metric_fn
+        takes_targets = _module_takes_targets(module)
+        if takes_targets and metric_fn is not None:
+            # The constructor guard only covers the trainer's own model;
+            # test() evaluates foreign modules through here too, and a
+            # fabricated 0.0 metric must not masquerade as a measurement.
+            raise ValueError(
+                "metric must be None when evaluating a model that computes "
+                "its own loss (its forward returns a scalar, not logits)"
+            )
 
         def eval_step(variables, x, y):
             kwargs = {"train": False} if takes_train else {}
+            if takes_targets:
+                # Self-loss model: the forward returns the scalar loss
+                # (metric is None for these — validated at construction).
+                return module.apply(variables, x, targets=y, **kwargs), (
+                    jnp.zeros(())
+                )
             out = module.apply(variables, x, **kwargs)
             loss = criterion(out, y)
             metric_val = (

@@ -121,3 +121,88 @@ def test_param_fingerprint_detects_change():
     tree2 = {"a": jnp.ones((4, 4)).at[0, 0].set(2.0), "b": jnp.zeros((3,))}
     assert param_fingerprint(tree2) != f1
     check_desync(tree)  # single-process: no-op
+
+
+# ------------------------------------------------------- chunked LM loss
+def test_chunked_lm_cross_entropy_matches_dense():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ml_trainer_tpu.ops.losses import chunked_lm_cross_entropy
+
+    rng = np.random.default_rng(0)
+    b, s, d, v = 2, 64, 16, 97
+    h = jnp.asarray(rng.normal(size=(b, s, d)), jnp.float32)
+    emb = jnp.asarray(rng.normal(size=(v, d)), jnp.float32)
+    t = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+
+    def dense(h, emb):
+        return jnp.mean(
+            optax.softmax_cross_entropy_with_integer_labels(
+                h @ emb.T, t
+            )
+        )
+
+    def chunked(h, emb):
+        return chunked_lm_cross_entropy(h, emb, t, chunk_size=16)
+
+    np.testing.assert_allclose(
+        chunked(h, emb), dense(h, emb), rtol=1e-5
+    )
+    gc = jax.grad(chunked, argnums=(0, 1))(h, emb)
+    gd = jax.grad(dense, argnums=(0, 1))(h, emb)
+    for a, b_ in zip(gc, gd):
+        np.testing.assert_allclose(a, b_, atol=1e-5, rtol=1e-4)
+    with pytest.raises(ValueError, match="not divisible"):
+        chunked_lm_cross_entropy(h, emb, t, chunk_size=60)
+
+
+def test_gpt2_chunked_loss_trains_and_matches_dense_trajectory(tmp_path):
+    """gpt2 with loss_chunk computes its own loss inside the forward (no
+    [B,S,V] logits tensor); the training trajectory must match the dense
+    criterion path on the same data/seed."""
+    ds = SyntheticTokens(size=32, seq_len=32, vocab_size=256, seed=3)
+
+    def run(**model_kw):
+        t = Trainer(
+            get_model("gpt2_tiny", max_len=32, **model_kw),
+            datasets=(ds, ds), epochs=2, batch_size=8,
+            model_dir=str(tmp_path), optimizer="sgd", lr=0.1, metric=None,
+        )
+        t.fit()
+        return t.train_losses + t.val_losses
+
+    dense = run()
+    chunked = run(loss_chunk=8)
+    np.testing.assert_allclose(chunked, dense, rtol=2e-4)
+
+
+def test_self_loss_model_rejects_metric(tmp_path):
+    ds = SyntheticTokens(size=16, seq_len=32, vocab_size=256, seed=0)
+    with pytest.raises(ValueError, match="metric must be None"):
+        Trainer(
+            get_model("gpt2_tiny", max_len=32, loss_chunk=8),
+            datasets=(ds, ds), epochs=1, batch_size=8,
+            model_dir=str(tmp_path), metric="accuracy",
+        )
+
+
+def test_foreign_self_loss_module_in_test_rejects_metric(tmp_path):
+    """test() evaluates foreign modules; a self-loss module under a
+    metric-bearing trainer must raise, not fabricate a 0.0 metric."""
+    import jax
+
+    ds = SyntheticTokens(size=16, seq_len=32, vocab_size=256, seed=0)
+    host = Trainer(
+        get_model("gpt2_tiny", max_len=32), datasets=(ds, ds), epochs=1,
+        batch_size=8, model_dir=str(tmp_path), metric="accuracy",
+    )
+    foreign = get_model("gpt2_tiny", max_len=32, loss_chunk=8)
+    variables = foreign.init(
+        {"params": jax.random.PRNGKey(0)},
+        np.zeros((1, 32), np.int32), train=False,
+    )
+    loader = Loader(ds, batch_size=8)
+    with pytest.raises(ValueError, match="metric must be None"):
+        host.test((foreign, variables), loader)
