@@ -33,6 +33,31 @@ from ml_trainer_tpu.utils.functions import custom_pre_process_function
 
 
 def build_datasets(args):
+    if args.synthetic_tokens:
+        # LM path: next-token prediction over synthetic token streams —
+        # makes the GPT-2 family runnable from this entry point (the
+        # image path below matches the reference's CIFAR-only CLI).
+        if args.custom_function:
+            raise SystemExit(
+                "--custom_function is the CIFAR augmentation pipeline; it "
+                "does not apply to --synthetic_tokens"
+            )
+        if "bert" in args.model:
+            raise SystemExit(
+                "--synthetic_tokens drives next-token LM training; the "
+                "bert models are sequence classifiers — fine-tune them on "
+                "a TokenizedDataset instead (examples/05_bert_finetune.py)"
+            )
+        from ml_trainer_tpu.data import SyntheticTokens
+
+        return (
+            SyntheticTokens(size=args.synthetic_train_size,
+                            seq_len=args.seq_len,
+                            vocab_size=args.vocab_size),
+            SyntheticTokens(size=args.synthetic_val_size,
+                            seq_len=args.seq_len,
+                            vocab_size=args.vocab_size, seed=1),
+        )
     transform = custom_pre_process_function() if args.custom_function else None
     if args.synthetic:
         return (
@@ -57,6 +82,14 @@ def main(args) -> None:
         }[args.dtype]
     if args.remat:
         model_kw["remat"] = True
+    if args.synthetic_tokens:
+        # The model's vocabulary/context must cover the synthetic stream.
+        model_kw["vocab_size"] = args.vocab_size
+        model_kw["max_len"] = args.seq_len
+    if args.loss_chunk:
+        model_kw["loss_chunk"] = args.loss_chunk
+    if args.moe_top_k != 1:
+        model_kw["moe_top_k"] = args.moe_top_k
     if model_kw:
         try:
             model = get_model(args.model, **model_kw)
@@ -64,7 +97,10 @@ def main(args) -> None:
             raise SystemExit(
                 f"model {args.model!r} does not accept {sorted(model_kw)} "
                 f"(--dtype applies to the transformer/resnet families, "
-                f"--remat to the transformer families): {e}"
+                f"--remat to the transformer families, --loss_chunk to the "
+                f"GPT-2 family, --moe_top_k to the MoE variants; "
+                f"--synthetic_tokens itself injects vocab_size/max_len, so "
+                f"it only pairs with the token models): {e}"
             )
     else:
         model = get_model(args.model)
@@ -144,6 +180,14 @@ def parse_args(argv=None):
                         help="resume from the latest full checkpoint")
     parser.add_argument("--synthetic", action="store_true",
                         help="use deterministic synthetic CIFAR-10 data")
+    parser.add_argument("--synthetic_tokens", action="store_true",
+                        help="use synthetic token streams (next-token "
+                             "prediction) — the LM path for the "
+                             "gpt2 family")
+    parser.add_argument("--seq_len", type=int, default=128,
+                        help="sequence length for --synthetic_tokens")
+    parser.add_argument("--vocab_size", type=int, default=1024,
+                        help="vocabulary size for --synthetic_tokens")
     parser.add_argument("--synthetic_train_size", type=int, default=2048)
     parser.add_argument("--synthetic_val_size", type=int, default=512)
     parser.add_argument("--dtype", type=str, default=None,
@@ -153,6 +197,14 @@ def parse_args(argv=None):
     parser.add_argument("--remat", action="store_true",
                         help="jax.checkpoint per transformer block "
                              "(activation memory O(depth) -> O(1) layers)")
+    parser.add_argument("--loss_chunk", type=int, default=0,
+                        help="GPT-2 family: compute the LM loss in "
+                             "sequence chunks of this size inside the "
+                             "forward — the [B,S,V] logits tensor is never "
+                             "materialized (metric must be none)")
+    parser.add_argument("--moe_top_k", type=int, default=1,
+                        help="MoE variants: experts per token "
+                             "(1 = Switch, 2 = GShard)")
     parser.add_argument("--profile", type=str, default=None,
                         help="directory for a jax.profiler trace of the "
                              "whole fit (TensorBoard-loadable)")

@@ -73,3 +73,24 @@ def test_plot_history_two_and_one_panel(tmp_path):
     h1 = dict(h2, train_metric=[], val_metric=[], metric_type=None)
     fig = plot_history(h1, show=False)
     assert fig is not None and len(fig.axes) == 1
+
+
+def test_main_cli_lm_path(tmp_path):
+    """main.py --synthetic_tokens: the transformer families are runnable
+    from the reference-shaped CLI entry point (chunked LM loss on)."""
+    import subprocess
+    import sys
+
+    root = os.path.join(os.path.dirname(__file__), "..")
+    r = subprocess.run(
+        [sys.executable, os.path.join(root, "main.py"),
+         "--synthetic_tokens", "--model", "gpt2_tiny", "--epochs", "1",
+         "--batch_size", "8", "--seq_len", "32",
+         "--synthetic_train_size", "32", "--synthetic_val_size", "16",
+         "--loss_chunk", "16", "--optimizer", "adamw",
+         "--backend", "cpu", "--model_dir", str(tmp_path)],
+        capture_output=True, text=True, timeout=420,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Training Complete." in r.stderr + r.stdout
