@@ -32,6 +32,7 @@ TPU-native internals (the deliberate re-design, SURVEY.md §7):
 from __future__ import annotations
 
 import gc
+import math
 import os
 import time
 from typing import Any, Optional, Tuple
@@ -168,6 +169,8 @@ class Trainer:
         grad_clip_norm: Optional[float] = None,
         ema_decay: Optional[float] = None,
         moe_aux_weight: float = 0.01,
+        early_stop_patience: Optional[int] = None,
+        save_best: bool = False,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -221,7 +224,17 @@ class Trainer:
         loss from ``models.moe.MoEMLP``).  Captured inside the compiled
         train step and added to the training loss, so top-1 routing is
         actually pushed toward balanced expert assignment; dense models sow
-        nothing and pay nothing."""
+        nothing and pay nothing.
+
+        ``early_stop_patience``: stop ``fit()`` after this many epochs
+        without a new best validation loss (the best/bad-epoch counters
+        live in checkpoints, so a resumed run keeps counting).  ``None``
+        (default) trains the full epoch budget like the reference.
+
+        ``save_best``: additionally export the weights to
+        ``<model_dir>/best`` whenever validation loss improves — the
+        every-epoch save overwrites with the LAST weights (ref behavior);
+        this keeps the best ones too."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -317,6 +330,14 @@ class Trainer:
                 f"moe_aux_weight must be >= 0, got {moe_aux_weight}"
             )
         self.moe_aux_weight = float(moe_aux_weight)
+        if early_stop_patience is not None and early_stop_patience < 1:
+            raise ValueError(
+                f"early_stop_patience must be >= 1, got {early_stop_patience}"
+            )
+        self.early_stop_patience = early_stop_patience
+        self.save_best = bool(save_best)
+        self._best_val = math.inf
+        self._bad_epochs = 0
         if self.is_parallel:
             # Rendezvous — the init_process_group analog (ref: src/trainer.py:59).
             initialize_distributed(cfg.backend)
@@ -982,6 +1003,18 @@ class Trainer:
         if resume:
             start_epoch = self._resume_from_latest(ckpt_dir)
         for epoch in range(start_epoch, self.epochs + 1):
+            if (
+                self.early_stop_patience is not None
+                and self._bad_epochs >= self.early_stop_patience
+            ):
+                # A resumed run may come back already out of patience: stop
+                # BEFORE training (and overwriting the exported weights
+                # with) a wasted extra epoch.
+                logger.info(
+                    f"Early stop: no val-loss improvement in "
+                    f"{self._bad_epochs} epochs (best {self._best_val:.6f})."
+                )
+                break
             logger.info(f"{'-' * 30} EPOCH {epoch} / {self.epochs} {'-' * 30}")
             self._train_one_epoch(epoch)
             self.clear()
@@ -989,6 +1022,14 @@ class Trainer:
             self.clear()
             if self._plateau is not None:
                 self._lr_scale = self._plateau.update(self.val_losses[-1])
+            # Every host computes the same val loss, so `improved` (and the
+            # stop decision) is globally consistent without a collective.
+            improved = self.val_losses[-1] < self._best_val
+            if improved:
+                self._best_val = self.val_losses[-1]
+                self._bad_epochs = 0
+            else:
+                self._bad_epochs += 1
             if process_count() > 1:
                 # Cross-host replica-desync check (the "race detector",
                 # SURVEY.md §5) — one scalar over DCN per epoch.
@@ -998,6 +1039,11 @@ class Trainer:
             # Save on the primary host only (ref: src/trainer.py:252-254).
             if is_primary():
                 self.save_model(self.model_dir)
+                if improved and self.save_best:
+                    # Same save path, same guard, same point in the epoch
+                    # as the export above — no second host-divergence
+                    # pattern to reason about.
+                    self.save_model(os.path.join(self.model_dir, "best"))
                 # Async: the write lands on the background writer thread
                 # while the next epoch trains (jax arrays are immutable, so
                 # the snapshot is consistent); fit-end joins the queue.
@@ -1017,8 +1063,17 @@ class Trainer:
             else:
                 logger.info(f"train loss: {self.train_losses[-1]}")
                 logger.info(f"valid loss: {self.val_losses[-1]}\n\n")
+            if (
+                self.early_stop_patience is not None
+                and self._bad_epochs >= self.early_stop_patience
+            ):
+                logger.info(
+                    f"Early stop: no val-loss improvement in "
+                    f"{self._bad_epochs} epochs (best {self._best_val:.6f})."
+                )
+                break
         self.history = {
-            "epochs": [*range(1, self.epochs + 1)],
+            "epochs": [*range(1, len(self.train_losses) + 1)],
             "train_loss": self.train_losses,
             "val_loss": self.val_losses,
             "train_metric": self.train_metrics,
@@ -1045,6 +1100,9 @@ class Trainer:
                 "num_bad_epochs": self._plateau.num_bad_epochs,
                 "scale": self._plateau.scale,
             }
+        h["early_stop"] = {
+            "best_val": self._best_val, "bad_epochs": self._bad_epochs,
+        }
         return h
 
     def _resume_from_latest(self, ckpt_dir: str) -> int:
@@ -1078,6 +1136,7 @@ class Trainer:
         else:  # non-primary host without the file; overwritten by broadcast
             state, saved, done_epoch = ckpt.fetch_to_host(self.state), {}, 0
         plateau = saved.get("plateau", {})
+        early = saved.get("early_stop", {})
         scalars = np.asarray(
             [
                 done_epoch,
@@ -1085,6 +1144,8 @@ class Trainer:
                 plateau.get("best", np.inf),
                 plateau.get("num_bad_epochs", 0),
                 plateau.get("scale", 1.0),
+                early.get("best_val", np.inf),
+                early.get("bad_epochs", 0),
             ],
             dtype=np.float64,
         )
@@ -1106,6 +1167,8 @@ class Trainer:
             self._plateau.best = float(scalars[2])
             self._plateau.num_bad_epochs = int(scalars[3])
             self._plateau.scale = float(scalars[4])
+        self._best_val = float(scalars[5])
+        self._bad_epochs = int(scalars[6])
         start_epoch = done_epoch + 1
         logger.info(f"Resuming from epoch {start_epoch} ({latest}).")
         return start_epoch

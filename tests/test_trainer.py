@@ -419,3 +419,71 @@ def test_bf16_mixed_precision_training(tmp_path):
     assert np.isfinite(t.train_losses[0]) and np.isfinite(t.val_losses[0])
     dtypes = {leaf.dtype for leaf in jax.tree.leaves(t.state.params)}
     assert dtypes == {jnp.dtype(jnp.float32)}, dtypes
+
+
+def test_early_stopping_halts_and_history_matches(tmp_path):
+    """With lr=0 the val loss never improves after epoch 1, so patience=2
+    stops at epoch 3; the history covers exactly the epochs that ran."""
+    ds = SyntheticCIFAR10(size=64)
+    t = Trainer(
+        MLModel(), datasets=(ds, ds), epochs=10, batch_size=16,
+        model_dir=str(tmp_path), metric=None, optimizer="sgd", lr=0.0,
+        early_stop_patience=2,
+    )
+    t.fit()
+    assert len(t.train_losses) == 3
+    assert t.history["epochs"] == [1, 2, 3]
+    assert len(t.history["val_loss"]) == 3
+
+
+def test_save_best_keeps_best_weights(tmp_path):
+    """save_best exports to <model_dir>/best on improvement; the final
+    every-epoch save still holds the LAST weights."""
+    import os
+
+    ds = SyntheticCIFAR10(size=64)
+    t = Trainer(
+        MLModel(), datasets=(ds, ds), epochs=2, batch_size=16,
+        model_dir=str(tmp_path), metric=None, optimizer="sgd", lr=0.05,
+        save_best=True,
+    )
+    t.fit()
+    assert os.path.exists(os.path.join(str(tmp_path), "best"))
+    from ml_trainer_tpu import load_model
+
+    best = load_model(MLModel(), os.path.join(str(tmp_path), "best"))
+    last = load_model(MLModel(), str(tmp_path))
+    # Both load fine; they differ unless the last epoch was the best.
+    assert best.variables.keys() == last.variables.keys()
+
+
+def test_early_stop_state_survives_resume(tmp_path):
+    """best/bad counters live in checkpoints: a resumed run continues the
+    patience countdown instead of resetting it."""
+    ds = SyntheticCIFAR10(size=64)
+    kw = dict(
+        datasets=(ds, ds), batch_size=16, model_dir=str(tmp_path),
+        metric=None, optimizer="sgd", lr=0.0, early_stop_patience=3,
+    )
+    t1 = Trainer(MLModel(), epochs=2, **kw)
+    t1.fit()  # 2 epochs: epoch 2 is the first bad epoch (lr=0)
+    assert t1._bad_epochs == 1
+    t2 = Trainer(MLModel(), epochs=10, **kw)
+    t2.fit(resume=True)
+    # Resumed with bad=1: stops after 2 more bad epochs (epoch 4).
+    assert len(t2.train_losses) == 4
+
+
+def test_resumed_run_already_out_of_patience_trains_zero_epochs(tmp_path):
+    ds = SyntheticCIFAR10(size=64)
+    kw = dict(
+        datasets=(ds, ds), batch_size=16, model_dir=str(tmp_path),
+        metric=None, optimizer="sgd", lr=0.0, early_stop_patience=1,
+    )
+    t1 = Trainer(MLModel(), epochs=3, **kw)
+    t1.fit()  # stops at epoch 2 (patience 1, lr=0)
+    assert len(t1.train_losses) == 2
+    t2 = Trainer(MLModel(), epochs=10, **kw)
+    t2.fit(resume=True)
+    # Out of patience at resume time: not a single extra epoch trains.
+    assert len(t2.train_losses) == 2
