@@ -8,6 +8,7 @@ from ml_trainer_tpu.checkpoint.checkpoint import (
     restore_checkpoint,
     save_checkpoint,
     save_model_variables,
+    write_model_bytes,
     wait_for_checkpoints,
 )
 from ml_trainer_tpu.checkpoint.torch_import import load_torch_checkpoint
@@ -22,6 +23,7 @@ __all__ = [
     "restore_checkpoint",
     "save_checkpoint",
     "save_model_variables",
+    "write_model_bytes",
     "wait_for_checkpoints",
     "load_torch_checkpoint",
 ]

@@ -63,9 +63,17 @@ def _atomic_write(path: str, data: bytes) -> None:
 
 def save_model_variables(model_dir: str, variables: Any) -> str:
     """Weights-only export, every-epoch cadence (ref: src/trainer.py:232-235)."""
+    return write_model_bytes(
+        model_dir, serialization.to_bytes(fetch_to_host(variables))
+    )
+
+
+def write_model_bytes(model_dir: str, data: bytes) -> str:
+    """Write an already-serialized export — lets a caller exporting to two
+    places (every-epoch + best) fetch and serialize once."""
     os.makedirs(model_dir, exist_ok=True)
     path = os.path.join(model_dir, MODEL_FILE)
-    _atomic_write(path, serialization.to_bytes(fetch_to_host(variables)))
+    _atomic_write(path, data)
     return path
 
 

@@ -1003,17 +1003,10 @@ class Trainer:
         if resume:
             start_epoch = self._resume_from_latest(ckpt_dir)
         for epoch in range(start_epoch, self.epochs + 1):
-            if (
-                self.early_stop_patience is not None
-                and self._bad_epochs >= self.early_stop_patience
-            ):
-                # A resumed run may come back already out of patience: stop
-                # BEFORE training (and overwriting the exported weights
-                # with) a wasted extra epoch.
-                logger.info(
-                    f"Early stop: no val-loss improvement in "
-                    f"{self._bad_epochs} epochs (best {self._best_val:.6f})."
-                )
+            # Checked at loop entry so a resumed run that comes back
+            # already out of patience stops BEFORE training (and
+            # overwriting the exported weights with) a wasted epoch.
+            if self._out_of_patience():
                 break
             logger.info(f"{'-' * 30} EPOCH {epoch} / {self.epochs} {'-' * 30}")
             self._train_one_epoch(epoch)
@@ -1038,12 +1031,19 @@ class Trainer:
                 check_desync(self.state.params)
             # Save on the primary host only (ref: src/trainer.py:252-254).
             if is_primary():
-                self.save_model(self.model_dir)
+                logger.info("Saving the model.")
+                from flax import serialization
+
+                # One device fetch + serialization covers both exports
+                # (the best/ copy is the same bytes on improving epochs).
+                data = serialization.to_bytes(
+                    ckpt.fetch_to_host(self._state_variables())
+                )
+                ckpt.write_model_bytes(self.model_dir, data)
                 if improved and self.save_best:
-                    # Same save path, same guard, same point in the epoch
-                    # as the export above — no second host-divergence
-                    # pattern to reason about.
-                    self.save_model(os.path.join(self.model_dir, "best"))
+                    ckpt.write_model_bytes(
+                        os.path.join(self.model_dir, "best"), data
+                    )
                 # Async: the write lands on the background writer thread
                 # while the next epoch trains (jax arrays are immutable, so
                 # the snapshot is consistent); fit-end joins the queue.
@@ -1063,14 +1063,7 @@ class Trainer:
             else:
                 logger.info(f"train loss: {self.train_losses[-1]}")
                 logger.info(f"valid loss: {self.val_losses[-1]}\n\n")
-            if (
-                self.early_stop_patience is not None
-                and self._bad_epochs >= self.early_stop_patience
-            ):
-                logger.info(
-                    f"Early stop: no val-loss improvement in "
-                    f"{self._bad_epochs} epochs (best {self._best_val:.6f})."
-                )
+            if self._out_of_patience():
                 break
         self.history = {
             "epochs": [*range(1, len(self.train_losses) + 1)],
@@ -1084,6 +1077,18 @@ class Trainer:
             self.save_history_(self.model_dir)
         ckpt.wait_for_checkpoints()
         logger.info("Training Complete.")
+
+    def _out_of_patience(self) -> bool:
+        stop = (
+            self.early_stop_patience is not None
+            and self._bad_epochs >= self.early_stop_patience
+        )
+        if stop:
+            logger.info(
+                f"Early stop: no val-loss improvement in "
+                f"{self._bad_epochs} epochs (best {self._best_val:.6f})."
+            )
+        return stop
 
     def _partial_history(self) -> dict:
         h = {

@@ -161,3 +161,19 @@ def test_beam_search_validates_args():
         beam_search(model, variables, ids, max_new_tokens=4, num_beams=0)
     with pytest.raises(ValueError, match="max_new_tokens"):
         beam_search(model, variables, ids, max_new_tokens=0)
+
+
+def test_generate_from_loss_chunk_model():
+    """The decode clone carries training-only attrs (loss_chunk) along;
+    generation must keep using the logits path regardless."""
+    import jax
+    import numpy as np
+
+    from ml_trainer_tpu.generate import generate
+    from ml_trainer_tpu.models import get_model
+
+    m = get_model("gpt2_tiny", max_len=64, loss_chunk=16)
+    variables = m.init({"params": jax.random.PRNGKey(0)},
+                       np.zeros((1, 8), np.int32), train=False)
+    out = generate(m, variables, np.ones((2, 8), np.int32), max_new_tokens=4)
+    assert out.shape == (2, 12)
