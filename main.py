@@ -80,8 +80,15 @@ def main(args) -> None:
             "float32": jnp.float32, "bfloat16": jnp.bfloat16,
             "bf16": jnp.bfloat16, "f32": jnp.float32,
         }[args.dtype]
+    if args.remat_policy != "none" and not args.remat:
+        raise SystemExit(
+            "--remat_policy only applies with --remat (it controls what "
+            "the per-block checkpoint may keep)"
+        )
     if args.remat:
         model_kw["remat"] = True
+        if args.remat_policy != "none":
+            model_kw["remat_policy"] = args.remat_policy
     if args.synthetic_tokens:
         # The model's vocabulary/context must cover the synthetic stream.
         model_kw["vocab_size"] = args.vocab_size
@@ -97,7 +104,8 @@ def main(args) -> None:
             raise SystemExit(
                 f"model {args.model!r} does not accept {sorted(model_kw)} "
                 f"(--dtype applies to the transformer/resnet families, "
-                f"--remat to the transformer families, --loss_chunk to the "
+                f"--remat/--remat_policy to the transformer families, "
+                f"--loss_chunk to the "
                 f"GPT-2 family, --moe_top_k to the MoE variants; "
                 f"--synthetic_tokens itself injects vocab_size/max_len, so "
                 f"it only pairs with the token models): {e}"
@@ -197,6 +205,11 @@ def parse_args(argv=None):
     parser.add_argument("--remat", action="store_true",
                         help="jax.checkpoint per transformer block "
                              "(activation memory O(depth) -> O(1) layers)")
+    parser.add_argument("--remat_policy", type=str, default="none",
+                        choices=["none", "dots"],
+                        help="with --remat: what the checkpoint may keep "
+                             "('dots' keeps matmul outputs — less "
+                             "recompute for some memory back)")
     parser.add_argument("--loss_chunk", type=int, default=0,
                         help="GPT-2 family: compute the LM loss in "
                              "sequence chunks of this size inside the "

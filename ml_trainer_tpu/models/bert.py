@@ -24,7 +24,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ml_trainer_tpu.models.layers import TransformerBlock
+from ml_trainer_tpu.models.layers import TransformerBlock, remat_block
 from ml_trainer_tpu.models.registry import register_model
 
 
@@ -42,6 +42,7 @@ class BertEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
     remat: bool = False  # jax.checkpoint each block (backward recompute)
+    remat_policy: str = "none"  # 'dots' keeps matmul outputs (see layers.remat_policy)
     right_padded: bool = False  # opt-in: masks are contiguous prefixes
 
     @nn.compact
@@ -78,11 +79,7 @@ class BertEncoder(nn.Module):
                 kv_lens = jnp.maximum(
                     attention_mask.astype(jnp.int32).sum(axis=-1), 1
                 )
-        Block = (
-            nn.remat(TransformerBlock, static_argnums=(3,))
-            if self.remat
-            else TransformerBlock
-        )
+        Block = remat_block(self.remat, self.remat_policy)
         for i in range(self.depth):
             x = Block(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,

@@ -147,6 +147,37 @@ class MLP(nn.Module):
         return x
 
 
+def remat_policy(name: str):
+    """Map a policy name to a jax.checkpoint saveable-filter (shared by
+    every transformer family's ``remat_policy`` knob).
+
+    'none': recompute everything in the backward (max memory savings);
+    'dots': keep matmul outputs, recompute only the elementwise chain —
+    the standard middle ground on TPU, where matmuls are the expensive
+    recompute and layernorm/gelu are nearly free."""
+    import jax
+
+    if name == "none":
+        return None
+    if name == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(
+        f"Unknown remat_policy {name!r}; expected 'none' or 'dots'"
+    )
+
+
+def remat_block(remat: bool, policy_name: str = "none"):
+    """The TransformerBlock constructor, wrapped in jax.checkpoint when
+    ``remat`` — one definition of the (static_argnums, policy) plumbing
+    for the gpt2/vit/bert families."""
+    if not remat:
+        return TransformerBlock
+    return nn.remat(
+        TransformerBlock, static_argnums=(3,),
+        policy=remat_policy(policy_name),
+    )
+
+
 class TransformerBlock(nn.Module):
     """Pre-LN transformer block (the GPT-2/ViT arrangement; BERT uses
     post-LN via the ``post_norm`` flag)."""

@@ -12,7 +12,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ml_trainer_tpu.models.layers import TransformerBlock
+from ml_trainer_tpu.models.layers import TransformerBlock, remat_block
 from ml_trainer_tpu.models.registry import register_model
 
 
@@ -27,6 +27,7 @@ class VisionTransformer(nn.Module):
     dtype: jnp.dtype = jnp.float32
     attention_impl: str = "auto"
     remat: bool = False  # jax.checkpoint each block (backward recompute)
+    remat_policy: str = "none"  # 'dots' keeps matmul outputs (see layers.remat_policy)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -43,11 +44,7 @@ class VisionTransformer(nn.Module):
         x = x + pos.astype(x.dtype)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        Block = (
-            nn.remat(TransformerBlock, static_argnums=(3,))
-            if self.remat
-            else TransformerBlock
-        )
+        Block = remat_block(self.remat, self.remat_policy)
         for i in range(self.depth):
             x = Block(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,

@@ -99,6 +99,7 @@ def create_hybrid_mesh(
     shape: Dict[str, int],
     *,
     dcn_axes: Sequence[str] = ("data",),
+    devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Multi-slice mesh: DCN-spanning axes get whole slices, ICI axes stay
     inside a slice.
@@ -118,7 +119,7 @@ def create_hybrid_mesh(
     semantics, so code written against the hybrid helper rehearses
     unchanged on the test mesh.
     """
-    devices = jax.devices()
+    devices = list(devices if devices is not None else jax.devices())
     num_slices = len({getattr(d, "slice_index", 0) for d in devices})
     if num_slices <= 1:
         return create_mesh(shape, devices)

@@ -131,3 +131,39 @@ def test_remat_gradients_match():
     assert np.allclose(l0, l1, rtol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
         assert np.allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_remat_policy_dots_matches_none():
+    """remat only changes WHAT is kept for backward, never the math: the
+    'dots' policy gradient must equal full-recompute and no-remat."""
+    import optax
+
+    from ml_trainer_tpu.models import get_model
+
+    ids = jnp.asarray(
+        np.random.default_rng(0).integers(0, 256, (2, 32)), jnp.int32
+    )
+    targets = jnp.roll(ids, -1, axis=1)
+
+    def grads(**kw):
+        m = get_model("gpt2_tiny", vocab_size=256, max_len=32, **kw)
+        v = m.init({"params": jax.random.PRNGKey(0)}, ids, train=False)
+
+        def loss(p):
+            out = m.apply({"params": p}, ids, train=True)
+            return jnp.mean(
+                optax.softmax_cross_entropy_with_integer_labels(out, targets)
+            )
+
+        return jax.grad(loss)(v["params"])
+
+    g_plain = grads()
+    g_full = grads(remat=True)
+    g_dots = grads(remat=True, remat_policy="dots")
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_dots)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_dots)):
+        np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="remat_policy"):
+        grads(remat=True, remat_policy="everything")
