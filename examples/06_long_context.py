@@ -1,0 +1,96 @@
+"""Long-context training — the full sequence-length stack on one command
+line.  No analog in the reference (it has no attention at all, SURVEY.md
+§5); this is the extension surface the TPU build treats as first-class:
+
+* sequence parallelism over the mesh (`SP` devices): ring attention
+  (`ATTN=ring`, K/V blocks rotate over ICI ppermute) or Ulysses
+  (`ATTN=ulysses`, head/sequence all-to-all) — the hidden states are
+  sharded over the sequence axis end-to-end, so per-device activation
+  memory scales 1/SP with the context;
+* per-block rematerialization (`REMAT=1`, optionally
+  `REMAT_POLICY=dots`) — backward activation memory O(1) blocks;
+* chunked LM loss (`LOSS_CHUNK=n`) — the [B, S, V] logits tensor is
+  never materialized (GPT-2 124M at 8x1024 would hold ~0.8 GB of it).
+
+    python examples/06_long_context.py                   # CPU-mesh smoke
+    SEQ_LEN=2048 SP=4 ATTN=ring REMAT=1 REMAT_POLICY=dots LOSS_CHUNK=128 \
+        python examples/06_long_context.py               # the long config
+
+All three levers are math-preserving: the trajectory equals the dense
+single-device run (tests/test_parallel.py::test_long_context_stack_composes).
+"""
+
+import os
+
+from ml_trainer_tpu import Trainer
+from ml_trainer_tpu.data import SyntheticTokens
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.parallel import create_mesh
+
+SEQ_LEN = int(os.environ.get("SEQ_LEN", "128"))
+SP = int(os.environ.get("SP", "4"))
+DP = int(os.environ.get("DP", "0"))  # 0 -> whatever SP leaves (02/04 style)
+BATCH = int(os.environ.get("BATCH", "8"))
+EPOCHS = int(os.environ.get("EPOCHS", "2"))
+ATTN = os.environ.get("ATTN", "ring")  # ring | ulysses
+MODEL_DIR = os.environ.get("MODEL_DIR", "model_output_longctx")
+
+
+def main():
+    n = int(os.environ.get("SYNTH_SIZE", "128"))
+    vocab = int(os.environ.get("VOCAB", "1024"))
+    datasets = (
+        SyntheticTokens(size=n, seq_len=SEQ_LEN, vocab_size=vocab),
+        SyntheticTokens(size=max(n // 4, 16), seq_len=SEQ_LEN,
+                        vocab_size=vocab, seed=1),
+    )
+    import jax
+
+    n_dev = jax.device_count()
+    dp = DP or max(n_dev // SP, 1)
+    if SP > 1 and dp * SP == n_dev:
+        mesh_shape = {"data": dp, "sequence": SP}
+        attn = ATTN
+        mesh = create_mesh(mesh_shape)
+    else:
+        # The sequence axis doesn't fit this machine (e.g. a single chip,
+        # or DP*SP != device count): run the same model dense — the remat
+        # and loss-chunk levers below still apply.
+        mesh_shape = {"data": n_dev}
+        attn = "auto"
+        mesh = None
+        print(f"# {dp}x{SP} mesh != {n_dev} devices; "
+              f"running dense on {mesh_shape}")
+    loss_chunk = int(os.environ.get("LOSS_CHUNK", "0"))
+    model = get_model(
+        "gpt2_tiny", vocab_size=vocab, max_len=SEQ_LEN,
+        attention_impl=attn, mesh=mesh,
+        remat=os.environ.get("REMAT") == "1",
+        remat_policy=os.environ.get("REMAT_POLICY", "none"),
+        loss_chunk=loss_chunk,
+    )
+    trainer = Trainer(
+        model,
+        datasets=datasets,
+        epochs=EPOCHS,
+        batch_size=BATCH,
+        is_parallel=True,
+        save_history=True,
+        mesh_shape=mesh_shape,
+        optimizer="adamw",
+        lr=float(os.environ.get("LR", "3e-4")),
+        scheduler="WarmupCosine",
+        metric=None,  # self-loss model when LOSS_CHUNK is set
+        model_dir=MODEL_DIR,
+    )
+    trainer.fit()
+    print({
+        "train_loss": trainer.train_losses[-1],
+        "val_loss": trainer.val_losses[-1],
+        "seq_len": SEQ_LEN, "sp": SP, "attn": attn,
+        "loss_chunk": loss_chunk,
+    })
+
+
+if __name__ == "__main__":
+    main()

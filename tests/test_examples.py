@@ -94,3 +94,12 @@ def test_main_cli_lm_path(tmp_path):
     )
     assert r.returncode == 0, r.stderr[-2000:]
     assert "Training Complete." in r.stderr + r.stdout
+
+
+def test_example_06_long_context(monkeypatch, tmp_path):
+    run_example("06_long_context.py", monkeypatch, tmp_path, {
+        "MODEL_DIR": str(tmp_path / "lc"), "EPOCHS": "1",
+        "SYNTH_SIZE": "32", "BATCH": "8", "SEQ_LEN": "64",
+        "REMAT": "1", "REMAT_POLICY": "dots", "LOSS_CHUNK": "16",
+    })
+    assert (tmp_path / "lc" / "history.pkl").exists()
