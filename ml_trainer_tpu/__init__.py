@@ -25,7 +25,7 @@ from ml_trainer_tpu.trainer import Trainer
 from ml_trainer_tpu.data import Loader, ArrayDataset, ShardedSampler
 from ml_trainer_tpu.models import MLModel
 from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
-from ml_trainer_tpu.generate import beam_search, generate
+from ml_trainer_tpu.generate import beam_search, generate, generate_ragged
 
 __version__ = "0.1.0"
 
@@ -41,6 +41,7 @@ __all__ = [
     "load_model",
     "plot_history",
     "generate",
+    "generate_ragged",
     "beam_search",
     "__version__",
 ]

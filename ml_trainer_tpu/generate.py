@@ -80,6 +80,64 @@ def generate(
     return run(params, prompt_ids, jnp.asarray(temperature, jnp.float32), rng)
 
 
+def generate_ragged(
+    model,
+    variables: dict,
+    prompts,
+    max_new_tokens: int,
+    **kwargs,
+) -> list:
+    """``generate`` for prompts of UNEQUAL lengths — length-bucketed.
+
+    The decode program requires a static [B, P] prompt block (static
+    shapes are what keep the whole loop one compiled program).  Rather
+    than pad — left-padding shifts positions and attends pad tokens;
+    right-padding would need per-row cache write positions — rows are
+    grouped by length and each group runs the ordinary compiled program.
+    The compiled-program cache keys on (batch, prompt_len), so each
+    group's batch is padded up to a power of two (repeating row 0; the
+    padding rows' outputs are dropped) — at most log2 program variants
+    per distinct length, regardless of how group sizes vary across
+    calls.  ``prompts``: sequence of non-empty 1-D int arrays; returns a
+    list of 1-D arrays in the same order, each
+    ``len(prompt) + max_new_tokens`` long.  ``kwargs`` pass through to
+    ``generate`` (temperature / top_k / rng); the rng is folded with
+    each bucket's length so samples stay independent across buckets.
+    """
+    prompts = list(prompts)  # tolerate generators: iterated twice below
+    by_len: dict = {}
+    for i, p in enumerate(prompts):
+        p = jnp.asarray(p)
+        if p.ndim != 1 or p.shape[0] == 0:
+            raise ValueError(
+                f"prompts must be non-empty 1-D token arrays; prompt {i} "
+                f"has shape {p.shape}"
+            )
+        by_len.setdefault(p.shape[0], []).append((i, p))
+    out: list = [None] * len(prompts)
+    rng = kwargs.pop("rng", None)
+    for length, group in sorted(by_len.items()):
+        idx, rows = zip(*group)
+        batch = jnp.stack(rows)
+        b = batch.shape[0]
+        b_pad = 1 << (b - 1).bit_length()
+        if b_pad > b:
+            batch = jnp.concatenate(
+                [batch, jnp.broadcast_to(batch[:1], (b_pad - b, length))]
+            )
+        group_kwargs = dict(kwargs)
+        if rng is not None:
+            # Identical keys across buckets would correlate their
+            # sampling noise; one fold per bucket restores independence.
+            group_kwargs["rng"] = jax.random.fold_in(rng, length)
+        done = generate(
+            model, variables, batch, max_new_tokens, **group_kwargs
+        )
+        for j, row in zip(idx, done[:b]):
+            out[j] = row
+    return out
+
+
 def beam_search(
     model,
     variables: dict,
