@@ -21,9 +21,9 @@ import jax
 import jax.numpy as jnp
 
 # Compiled decode programs keyed by (module, batch, prompt_len,
-# max_new_tokens, dtype, greedy, top_k) — flax modules are frozen
-# dataclasses, hence hashable keys.  top_k is static (recompiles);
-# temperature is traced (does not).
+# max_new_tokens, dtype, greedy, top_k, top_p) — flax modules are frozen
+# dataclasses, hence hashable keys.  top_k/top_p are static (each value
+# compiles its own program); temperature is traced (does not).
 _COMPILED: dict = {}
 
 
@@ -34,6 +34,7 @@ def generate(
     max_new_tokens: int,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
@@ -43,8 +44,9 @@ def generate(
     ``{'params': ...}``.  ``temperature=0`` is greedy argmax; otherwise
     categorical sampling at ``temperature`` (``rng`` seeds it; temperature
     is traced, so changing it does not recompile), optionally restricted
-    to the ``top_k`` most probable tokens.  Returns
-    [B, P + max_new_tokens] token ids.
+    to the ``top_k`` most probable tokens and/or the nucleus holding
+    ``top_p`` probability mass (both filters compose: top_k first).
+    Returns [B, P + max_new_tokens] token ids.
     """
     params = variables["params"] if "params" in variables else variables
     b, prompt_len = prompt_ids.shape
@@ -54,13 +56,16 @@ def generate(
         raise ValueError(
             f"top_k must be in [1, vocab_size={model.vocab_size}], got {top_k}"
         )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if max_new_tokens == 0:
         return prompt_ids
     greedy = temperature == 0.0
     if greedy:
-        # Greedy ignores top_k; normalize so the compile cache doesn't
-        # build duplicate byte-identical programs per top_k value.
+        # Greedy ignores the filters; normalize so the compile cache
+        # doesn't build duplicate byte-identical programs per value.
         top_k = None
+        top_p = None
     total = prompt_len + max_new_tokens
     if total > model.max_len:
         raise ValueError(
@@ -71,11 +76,14 @@ def generate(
         rng = jax.random.PRNGKey(0)
 
     key = (
-        model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy, top_k,
+        model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy,
+        top_k, top_p,
     )
     run = _COMPILED.get(key)
     if run is None:
-        run = _build(model, b, prompt_ids.dtype, max_new_tokens, greedy, top_k)
+        run = _build(
+            model, b, prompt_ids.dtype, max_new_tokens, greedy, top_k, top_p
+        )
         _COMPILED[key] = run
     return run(params, prompt_ids, jnp.asarray(temperature, jnp.float32), rng)
 
@@ -101,8 +109,8 @@ def generate_ragged(
     calls.  ``prompts``: sequence of non-empty 1-D int arrays; returns a
     list of 1-D arrays in the same order, each
     ``len(prompt) + max_new_tokens`` long.  ``kwargs`` pass through to
-    ``generate`` (temperature / top_k / rng); the rng is folded with
-    each bucket's length so samples stay independent across buckets.
+    ``generate`` (temperature / top_k / top_p / rng); the rng is folded
+    with each bucket's length so samples stay independent across buckets.
     """
     prompts = list(prompts)  # tolerate generators: iterated twice below
     by_len: dict = {}
@@ -262,7 +270,7 @@ def _build_beam(model, b, dtype, max_new_tokens, k):
     return run
 
 
-def _build(model, b, dtype, max_new_tokens, greedy, top_k=None):
+def _build(model, b, dtype, max_new_tokens, greedy, top_k=None, top_p=None):
     dm = model.clone(decode=True)
     cache_shapes = _cache_shapes(dm, b, dtype)
 
@@ -273,6 +281,22 @@ def _build(model, b, dtype, max_new_tokens, greedy, top_k=None):
             # Keep the k most probable logits; the rest cannot be drawn.
             kth = jax.lax.top_k(last, top_k)[0][:, -1:]
             last = jnp.where(last < kth, -jnp.inf, last)
+        if top_p is not None:
+            # Nucleus: keep the smallest probability mass >= top_p.  Sort
+            # descending, find each row's cutoff logit, mask below it —
+            # rank-space work stays static-shaped for XLA.  The first
+            # token always survives (its EXCLUSIVE cumulative mass is 0),
+            # so the distribution cannot empty out.
+            sorted_logits = jnp.sort(last, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits / temperature, axis=-1)
+            mass_before = jnp.cumsum(probs, axis=-1) - probs
+            keep = mass_before < top_p                 # [B, V] in rank space
+            # Cutoff = smallest kept logit per row.
+            cutoff = jnp.min(
+                jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+                keepdims=True,
+            )
+            last = jnp.where(last < cutoff, -jnp.inf, last)
         return jax.random.categorical(
             jax.random.fold_in(rng, t), last / temperature, axis=-1
         ).astype(dtype)

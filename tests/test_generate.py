@@ -236,3 +236,31 @@ def test_generate_ragged_pads_batch_to_power_of_two():
         generate_ragged(
             m, variables, [np.asarray([], np.int32)], max_new_tokens=2
         )
+
+
+def test_top_p_nucleus_sampling():
+    """top_p must restrict draws to the nucleus: with a distribution where
+    one token holds most of the mass, a tight top_p collapses sampling to
+    argmax; top_p=1.0 leaves the distribution unchanged (same draws as
+    unfiltered sampling at the same rng)."""
+    m = get_model("gpt2_tiny", max_len=64)
+    variables = m.init({"params": jax.random.PRNGKey(0)},
+                       np.zeros((1, 8), np.int32), train=False)
+    prompt = np.ones((2, 8), np.int32)
+    rng = jax.random.PRNGKey(7)
+
+    greedy = generate(m, variables, prompt, max_new_tokens=6)
+    tight = generate(m, variables, prompt, max_new_tokens=6,
+                     temperature=0.05, top_p=1e-6, rng=rng)
+    # Nucleus of ~one token at near-zero temperature == greedy path.
+    np.testing.assert_array_equal(tight, greedy)
+
+    full = generate(m, variables, prompt, max_new_tokens=6,
+                    temperature=1.0, top_p=1.0, rng=rng)
+    plain = generate(m, variables, prompt, max_new_tokens=6,
+                     temperature=1.0, rng=rng)
+    np.testing.assert_array_equal(full, plain)
+
+    with pytest.raises(ValueError, match="top_p"):
+        generate(m, variables, prompt, max_new_tokens=2,
+                 temperature=1.0, top_p=0.0)
