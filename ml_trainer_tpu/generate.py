@@ -21,9 +21,10 @@ import jax
 import jax.numpy as jnp
 
 # Compiled decode programs keyed by (module, batch, prompt_len,
-# max_new_tokens, dtype, greedy, top_k, top_p) — flax modules are frozen
-# dataclasses, hence hashable keys.  top_k/top_p are static (each value
-# compiles its own program); temperature is traced (does not).
+# max_new_tokens, dtype, greedy, top_k, top_p, eos_token_id,
+# pad_token_id) — flax modules are frozen dataclasses, hence hashable
+# keys.  The filter/stop values are static (each compiles its own
+# program); temperature is traced (does not).
 _COMPILED: dict = {}
 
 
@@ -35,6 +36,8 @@ def generate(
     temperature: float = 0.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
@@ -46,7 +49,11 @@ def generate(
     is traced, so changing it does not recompile), optionally restricted
     to the ``top_k`` most probable tokens and/or the nucleus holding
     ``top_p`` probability mass (both filters compose: top_k first).
-    Returns [B, P + max_new_tokens] token ids.
+    With ``eos_token_id``, a row that emits EOS keeps its static shape
+    but pads every later position with ``pad_token_id`` (the decode loop
+    still runs — static shapes are the whole design — the finished
+    row's draws are just masked out).  Returns
+    [B, P + max_new_tokens] token ids.
     """
     params = variables["params"] if "params" in variables else variables
     b, prompt_len = prompt_ids.shape
@@ -58,6 +65,17 @@ def generate(
         )
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if eos_token_id is not None and not 0 <= eos_token_id < model.vocab_size:
+        raise ValueError(
+            f"eos_token_id must be in [0, vocab_size={model.vocab_size}), "
+            f"got {eos_token_id} (a different tokenizer's id would silently "
+            "never stop generation)"
+        )
+    if eos_token_id is not None and not 0 <= pad_token_id < model.vocab_size:
+        raise ValueError(
+            f"pad_token_id must be in [0, vocab_size={model.vocab_size}), "
+            f"got {pad_token_id}"
+        )
     if max_new_tokens == 0:
         return prompt_ids
     greedy = temperature == 0.0
@@ -66,6 +84,9 @@ def generate(
         # doesn't build duplicate byte-identical programs per value.
         top_k = None
         top_p = None
+    if eos_token_id is None:
+        # pad is unused without eos — same normalization rationale.
+        pad_token_id = 0
     total = prompt_len + max_new_tokens
     if total > model.max_len:
         raise ValueError(
@@ -77,12 +98,13 @@ def generate(
 
     key = (
         model, b, prompt_len, max_new_tokens, prompt_ids.dtype, greedy,
-        top_k, top_p,
+        top_k, top_p, eos_token_id, pad_token_id,
     )
     run = _COMPILED.get(key)
     if run is None:
         run = _build(
-            model, b, prompt_ids.dtype, max_new_tokens, greedy, top_k, top_p
+            model, b, prompt_ids.dtype, max_new_tokens, greedy, top_k,
+            top_p, eos_token_id, pad_token_id,
         )
         _COMPILED[key] = run
     return run(params, prompt_ids, jnp.asarray(temperature, jnp.float32), rng)
@@ -270,7 +292,8 @@ def _build_beam(model, b, dtype, max_new_tokens, k):
     return run
 
 
-def _build(model, b, dtype, max_new_tokens, greedy, top_k=None, top_p=None):
+def _build(model, b, dtype, max_new_tokens, greedy, top_k=None, top_p=None,
+           eos_token_id=None, pad_token_id=0):
     dm = model.clone(decode=True)
     cache_shapes = _cache_shapes(dm, b, dtype)
 
@@ -301,6 +324,15 @@ def _build(model, b, dtype, max_new_tokens, greedy, top_k=None, top_p=None):
             jax.random.fold_in(rng, t), last / temperature, axis=-1
         ).astype(dtype)
 
+    def mask_done(tok, done):
+        """After a row emits EOS, later positions become pad; returns the
+        (masked token, updated done flag) pair."""
+        if eos_token_id is None:
+            return tok, done
+        tok = jnp.where(done[:, None], jnp.asarray(pad_token_id, dtype), tok)
+        done = jnp.logical_or(done, tok[:, 0] == eos_token_id)
+        return tok, done
+
     @jax.jit
     def run(params, prompt_ids, temperature, rng):
         cache = _empty_cache(cache_shapes)
@@ -312,18 +344,20 @@ def _build(model, b, dtype, max_new_tokens, greedy, top_k=None, top_p=None):
         )
         cache = mut["cache"]
         tok = sample(logits[:, -1], temperature, rng, 0)[:, None]
+        tok, done0 = mask_done(tok, jnp.zeros((b,), bool))
 
         def step(carry, t):
-            cache, tok = carry
+            cache, tok, done = carry
             logits, mut = dm.apply(
                 {"params": params, "cache": cache}, tok,
                 train=False, mutable=["cache"],
             )
             nxt = sample(logits[:, -1], temperature, rng, t)[:, None]
-            return (mut["cache"], nxt), tok
+            nxt, done = mask_done(nxt, done)
+            return (mut["cache"], nxt, done), tok
 
-        (_, last_tok), toks = jax.lax.scan(
-            step, (cache, tok), jnp.arange(1, max_new_tokens)
+        (_, last_tok, _), toks = jax.lax.scan(
+            step, (cache, tok, done0), jnp.arange(1, max_new_tokens)
         )
         # toks holds tokens 0..n-2 (each step emits its INPUT); append the
         # final sampled one.
