@@ -6,13 +6,18 @@ the Trainer (ref: src/trainer.py:115-172) — split into a proper ops layer so
 they are pure, jit-able functions instead of device-bound torch modules.
 """
 
-from ml_trainer_tpu.ops.optimizers import get_optimizer, OPTIMIZERS
+from ml_trainer_tpu.ops.optimizers import (
+    decay_mask_matrices_only,
+    get_optimizer,
+    OPTIMIZERS,
+)
 from ml_trainer_tpu.ops.schedules import make_lr_schedule, PlateauController, SCHEDULERS
 from ml_trainer_tpu.ops.losses import get_criterion, CRITERIA
 from ml_trainer_tpu.ops.metrics import get_metric, METRICS
 from ml_trainer_tpu.ops.predictions import get_prediction_function, get_predictions
 
 __all__ = [
+    "decay_mask_matrices_only",
     "get_optimizer",
     "OPTIMIZERS",
     "make_lr_schedule",

@@ -23,50 +23,62 @@ from __future__ import annotations
 
 from typing import Callable, Union
 
+import jax
 import optax
 
 ScalarOrSchedule = Union[float, Callable]
 
 
-def _with_coupled_decay(tx: optax.GradientTransformation, weight_decay: float):
+def _with_coupled_decay(tx: optax.GradientTransformation, weight_decay: float,
+                        mask=None):
     """Torch-style coupled L2: grad += wd * param, applied before the inner tx."""
     if weight_decay:
-        return optax.chain(optax.add_decayed_weights(weight_decay), tx)
+        return optax.chain(
+            optax.add_decayed_weights(weight_decay, mask=mask), tx
+        )
     return tx
 
 
-def _sgd(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
+def _sgd(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+         mask=None):
     return _with_coupled_decay(
-        optax.sgd(lr, momentum=momentum if momentum else None), weight_decay
+        optax.sgd(lr, momentum=momentum if momentum else None),
+        weight_decay, mask,
     )
 
 
-def _adam(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
-    return _with_coupled_decay(optax.adam(lr), weight_decay)
+def _adam(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+          mask=None):
+    return _with_coupled_decay(optax.adam(lr), weight_decay, mask)
 
 
-def _adagrad(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
-    return _with_coupled_decay(optax.adagrad(lr), weight_decay)
+def _adagrad(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+             mask=None):
+    return _with_coupled_decay(optax.adagrad(lr), weight_decay, mask)
 
 
-def _adamax(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
-    return _with_coupled_decay(optax.adamax(lr), weight_decay)
+def _adamax(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+            mask=None):
+    return _with_coupled_decay(optax.adamax(lr), weight_decay, mask)
 
 
-def _adamw(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
-    return optax.adamw(lr, weight_decay=weight_decay)
+def _adamw(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+           mask=None):
+    return optax.adamw(lr, weight_decay=weight_decay, mask=mask)
 
 
-def _lamb(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
+def _lamb(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+          mask=None):
     # LAMB (layerwise-adaptive Adam): the large-batch TPU recipe used for
     # BERT pretraining — decoupled decay like adamw, per-layer trust ratio.
-    return optax.lamb(lr, weight_decay=weight_decay)
+    return optax.lamb(lr, weight_decay=weight_decay, mask=mask)
 
 
-def _lion(lr: ScalarOrSchedule, momentum: float, weight_decay: float):
+def _lion(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+          mask=None):
     # Lion: sign-momentum optimizer; one moment buffer instead of Adam's
     # two — 2x less optimizer HBM for the big-model configs.
-    return optax.lion(lr, weight_decay=weight_decay)
+    return optax.lion(lr, weight_decay=weight_decay, mask=mask)
 
 
 # The first five names are the reference set (ref: src/trainer.py:123-138);
@@ -82,18 +94,41 @@ OPTIMIZERS = {
 }
 
 
+def decay_mask_matrices_only(params):
+    """The standard transformer decay mask: weight decay applies to
+    matrices (ndim >= 2 — the matmul kernels and embeddings) and skips
+    biases / LayerNorm scales (1-D), whose decay is known to hurt.  Pass
+    as ``decay_mask`` to ``get_optimizer`` (Trainer:
+    ``decay_exclude_bias_norm=True``)."""
+    return jax.tree.map(lambda p: getattr(p, "ndim", 0) >= 2, params)
+
+
+def _decay_all(params):
+    """The default mask (torch semantics: decay everything).  A mask is
+    ALWAYS passed so the optax ``masked`` wrapper — and therefore the
+    opt_state pytree structure and checkpoints — is identical whichever
+    mask is in force; toggling ``decay_exclude_bias_norm`` across a
+    resume must not change the state tree (same invariant the trainer
+    keeps for grad clipping)."""
+    return jax.tree.map(lambda _: True, params)
+
+
 def get_optimizer(
     name: str,
     learning_rate: ScalarOrSchedule,
     momentum: float = 0.9,
     weight_decay: float = 0.0,
+    decay_mask=None,
 ) -> optax.GradientTransformation:
     """Map an optimizer name to an optax transformation.
 
     The reference's five names (ref: src/trainer.py:123-138) plus
     ``lamb``/``lion`` for the north-star configs.  Unknown names raise
     ``ValueError`` (the reference silently returns ``None`` — a latent bug we
-    do not replicate).
+    do not replicate).  ``decay_mask``: optional params -> bool-pytree
+    callable restricting which leaves weight decay touches (torch
+    semantics — decay everything — is the default, matching the
+    reference; see ``decay_mask_matrices_only``).
     """
     try:
         factory = OPTIMIZERS[name]
@@ -101,4 +136,6 @@ def get_optimizer(
         raise ValueError(
             f"Unknown optimizer {name!r}; expected one of {sorted(OPTIMIZERS)}"
         ) from None
-    return factory(learning_rate, momentum, weight_decay)
+    return factory(
+        learning_rate, momentum, weight_decay, decay_mask or _decay_all
+    )

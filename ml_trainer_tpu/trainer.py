@@ -49,6 +49,7 @@ from ml_trainer_tpu.config import TrainerConfig, ALLOWED_KWARGS, validate_kwargs
 from ml_trainer_tpu.data import Loader, ShardedSampler, prefetch_to_device
 from ml_trainer_tpu.models.registry import get_model
 from ml_trainer_tpu.ops import (
+    decay_mask_matrices_only,
     get_criterion,
     get_metric,
     get_optimizer,
@@ -171,6 +172,7 @@ class Trainer:
         moe_aux_weight: float = 0.01,
         early_stop_patience: Optional[int] = None,
         save_best: bool = False,
+        decay_exclude_bias_norm: bool = False,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -234,7 +236,12 @@ class Trainer:
         ``save_best``: additionally export the weights to
         ``<model_dir>/best`` whenever validation loss improves — the
         every-epoch save overwrites with the LAST weights (ref behavior);
-        this keeps the best ones too."""
+        this keeps the best ones too.
+
+        ``decay_exclude_bias_norm``: apply weight decay to matrices only
+        (ndim >= 2), skipping biases and LayerNorm params — the standard
+        transformer recipe.  Default False = torch/reference semantics
+        (decay everything)."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -336,6 +343,7 @@ class Trainer:
             )
         self.early_stop_patience = early_stop_patience
         self.save_best = bool(save_best)
+        self.decay_exclude_bias_norm = bool(decay_exclude_bias_norm)
         self._best_val = math.inf
         self._bad_epochs = 0
         if self.is_parallel:
@@ -553,7 +561,11 @@ class Trainer:
             ),
         )
         self.tx = get_optimizer(
-            cfg.optimizer, self.lr_schedule, cfg.momentum, cfg.weight_decay
+            cfg.optimizer, self.lr_schedule, cfg.momentum, cfg.weight_decay,
+            decay_mask=(
+                decay_mask_matrices_only
+                if self.decay_exclude_bias_norm else None
+            ),
         )
         # Always chain (both clip and identity carry EmptyState), so the
         # opt_state pytree structure — and therefore checkpoints — do not

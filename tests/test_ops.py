@@ -203,3 +203,43 @@ def test_warmup_linear_schedule():
     mid = float(sched(52))  # ~halfway through the 95-step decay
     assert 0.9 < mid < 1.1
     assert float(sched(100)) < 1e-6
+
+
+def test_decay_mask_skips_biases_and_norms():
+    """With decay_mask_matrices_only, weight decay moves matrices but not
+    1-D params (biases / LayerNorm scales), for both the decoupled
+    (adamw) and coupled (sgd) families."""
+    from ml_trainer_tpu.ops.optimizers import decay_mask_matrices_only
+
+    params = {"w": jnp.ones((3, 3)), "b": jnp.ones((3,))}
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    for name in ("adamw", "sgd"):
+        tx = get_optimizer(name, 0.1, momentum=0.0, weight_decay=0.1,
+                           decay_mask=decay_mask_matrices_only)
+        state = tx.init(params)
+        updates, _ = tx.update(zeros, state, params)
+        assert not np.allclose(updates["w"], 0.0), name
+        np.testing.assert_allclose(updates["b"], 0.0, err_msg=name)
+        # Unmasked: both decay.
+        tx_all = get_optimizer(name, 0.1, momentum=0.0, weight_decay=0.1)
+        updates_all, _ = tx_all.update(zeros, tx_all.init(params), params)
+        assert not np.allclose(updates_all["b"], 0.0), name
+
+
+def test_decay_mask_does_not_change_opt_state_structure():
+    """A mask is always passed (all-True default), so toggling the
+    exclusion cannot change the opt_state pytree — the checkpoint/resume
+    invariant the trainer keeps for grad clipping."""
+    from ml_trainer_tpu.ops.optimizers import decay_mask_matrices_only
+
+    params = {"w": jnp.ones((3, 3)), "b": jnp.ones((3,))}
+    for name in ("adamw", "sgd", "lion"):
+        s_default = get_optimizer(name, 0.1, weight_decay=0.1).init(params)
+        s_masked = get_optimizer(
+            name, 0.1, weight_decay=0.1,
+            decay_mask=decay_mask_matrices_only,
+        ).init(params)
+        assert (
+            jax.tree_util.tree_structure(s_default)
+            == jax.tree_util.tree_structure(s_masked)
+        ), name
