@@ -137,6 +137,9 @@ def main(args) -> None:
         shard_opt_state=args.shard_opt_state,
         grad_clip_norm=args.grad_clip_norm,
         ema_decay=args.ema_decay,
+        early_stop_patience=args.early_stop_patience,
+        save_best=args.save_best,
+        decay_exclude_bias_norm=args.decay_exclude_bias_norm,
         **config,
     )
     if args.profile:
@@ -237,6 +240,16 @@ def parse_args(argv=None):
     parser.add_argument("--ema_decay", type=float, default=None,
                         help="keep an exponential moving average of the "
                              "params; eval/save then use the EMA weights")
+    parser.add_argument("--early_stop_patience", type=int, default=None,
+                        help="stop when validation loss has not improved "
+                             "for this many epochs (counters live in "
+                             "checkpoints, so --resume keeps counting)")
+    parser.add_argument("--save_best", action="store_true",
+                        help="also export weights to <model_dir>/best "
+                             "whenever validation loss improves")
+    parser.add_argument("--decay_exclude_bias_norm", action="store_true",
+                        help="weight decay touches matrices only (skip "
+                             "biases/LayerNorm — the transformer recipe)")
     # SageMaker-compatible env-backed paths (ref: main.py:80-83), with sane
     # defaults when the env vars are absent.
     parser.add_argument("--model_dir", type=str,

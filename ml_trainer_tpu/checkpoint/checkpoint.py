@@ -261,26 +261,65 @@ def _reconcile_ema(state_template: Any, saved: Any) -> Any:
     return saved
 
 
+def _inject_masked_levels(template_sd: Any, saved: Any) -> Any:
+    """Align a pre-decay-mask opt_state dict with a template that carries
+    ``optax.masked`` wrappers: wherever the template expects the
+    single-key ``{'inner_state': ...}`` level (MaskedState) and the saved
+    dict holds the bare inner state, inject the level.  Purely structural
+    — leaf values are untouched."""
+    if isinstance(template_sd, dict):
+        t_keys = set(template_sd.keys())
+        saved_is_masked = isinstance(saved, dict) and set(
+            saved.keys()
+        ) == {"inner_state"}
+        if t_keys == {"inner_state"} and not saved_is_masked:
+            return {
+                "inner_state": _inject_masked_levels(
+                    template_sd["inner_state"], saved
+                )
+            }
+        if isinstance(saved, dict):
+            return {
+                k: (
+                    _inject_masked_levels(template_sd[k], v)
+                    if k in template_sd else v
+                )
+                for k, v in saved.items()
+            }
+    return saved
+
+
 def _from_state_dict_compat(state_template: Any, saved: Any) -> Any:
-    """``from_state_dict`` with a fallback for checkpoints written before the
-    trainer wrapped every optimizer in ``chain(clip-or-identity, inner)``:
-    their opt_state lacks the outer chain level, so re-nest it under the
-    template's ``{'0': {}, '1': inner}`` shape and retry."""
+    """``from_state_dict`` with fallbacks for checkpoints written by older
+    trainer versions: (a) before every optimizer was wrapped in
+    ``chain(clip-or-identity, inner)`` — re-nest under the template's
+    ``{'0': {}, '1': inner}`` shape; (b) before a weight-decay mask was
+    always passed — inject the ``MaskedState`` ``inner_state`` levels the
+    new opt_state carries.  Retried in combination; on failure the
+    ORIGINAL mismatch is re-raised (e.g. optimizer changed between save
+    and resume — the real story, not a fallback's secondary failure)."""
     saved = _reconcile_ema(state_template, saved)
     try:
         return serialization.from_state_dict(state_template, saved)
     except (ValueError, KeyError, AttributeError) as orig:
         if not (isinstance(saved, dict) and "opt_state" in saved):
             raise
-        wrapped = dict(saved)
-        wrapped["opt_state"] = {"0": {}, "1": saved["opt_state"]}
-        try:
-            return serialization.from_state_dict(state_template, wrapped)
-        except Exception:
-            # The legacy re-nest didn't apply: the ORIGINAL mismatch (e.g.
-            # optimizer changed between save and resume) is the real story,
-            # not the fallback's secondary failure.
-            raise orig
+        template_sd = serialization.to_state_dict(state_template)
+        candidates = []
+        renested = {"0": {}, "1": saved["opt_state"]}
+        for opt_sd in (saved["opt_state"], renested):
+            candidates.append(opt_sd)
+            candidates.append(
+                _inject_masked_levels(template_sd.get("opt_state"), opt_sd)
+            )
+        for opt_sd in candidates[1:]:  # [0] is what already failed
+            wrapped = dict(saved)
+            wrapped["opt_state"] = opt_sd
+            try:
+                return serialization.from_state_dict(state_template, wrapped)
+            except Exception:
+                continue
+        raise orig
 
 
 def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:

@@ -130,3 +130,45 @@ def test_crashed_inflight_write_never_shadows_last_good(tmp_path):
     ckpt.wait_for_checkpoints()
     assert not os.path.exists(debris)
     assert ckpt.latest_checkpoint(str(tmp_path)) == path2
+
+
+def test_restore_pre_decay_mask_checkpoint():
+    """Checkpoints written before the optimizer factory always passed a
+    weight-decay mask lack the MaskedState levels; the compat shim must
+    inject them so old checkpoints keep resuming."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from flax import serialization
+
+    from ml_trainer_tpu.checkpoint.checkpoint import _from_state_dict_compat
+    from ml_trainer_tpu.ops import get_optimizer
+    from ml_trainer_tpu.train_state import TrainState
+
+    params = {"w": jnp.ones((3, 3)), "b": jnp.ones((3,))}
+
+    def make_state(tx):
+        return TrainState(
+            step=jnp.zeros((), jnp.int32), params=params,
+            opt_state=optax.chain(optax.identity(), tx).init(params),
+            batch_stats={}, rng=jax.random.PRNGKey(0),
+        )
+
+    # Old writer: bare optax.adamw (no mask -> no MaskedState level).
+    old_sd = serialization.to_state_dict(
+        make_state(optax.adamw(0.1, weight_decay=0.1))
+    )
+    # New reader: factory optimizer (mask always present).
+    template = make_state(get_optimizer("adamw", 0.1, weight_decay=0.1))
+    restored = _from_state_dict_compat(template, old_sd)
+    assert (
+        jax.tree_util.tree_structure(restored)
+        == jax.tree_util.tree_structure(template)
+    )
+    # And a new-format state dict round-trips untouched.
+    new_sd = serialization.to_state_dict(template)
+    round_trip = _from_state_dict_compat(template, new_sd)
+    assert (
+        jax.tree_util.tree_structure(round_trip)
+        == jax.tree_util.tree_structure(template)
+    )
