@@ -140,6 +140,7 @@ def main(args) -> None:
         early_stop_patience=args.early_stop_patience,
         save_best=args.save_best,
         decay_exclude_bias_norm=args.decay_exclude_bias_norm,
+        label_smoothing=args.label_smoothing,
         **config,
     )
     if args.profile:
@@ -250,6 +251,10 @@ def parse_args(argv=None):
     parser.add_argument("--decay_exclude_bias_norm", action="store_true",
                         help="weight decay touches matrices only (skip "
                              "biases/LayerNorm — the transformer recipe)")
+    parser.add_argument("--label_smoothing", type=float, default=0.0,
+                        help="mix one-hot targets with the uniform "
+                             "distribution at this weight (cross_entropy "
+                             "only; the ViT/ResNet recipe)")
     # SageMaker-compatible env-backed paths (ref: main.py:80-83), with sane
     # defaults when the env vars are absent.
     parser.add_argument("--model_dir", type=str,

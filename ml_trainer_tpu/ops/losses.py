@@ -34,6 +34,28 @@ def cross_entropy(outputs, targets):
     )
 
 
+def cross_entropy_smoothed(label_smoothing: float) -> Callable:
+    """Cross entropy with smoothed targets — the ViT/ResNet recipe
+    ingredient (``torch.nn.CrossEntropyLoss(label_smoothing=...)``
+    semantics, including the degenerate-but-legal 1.0 = pure uniform
+    targets): each one-hot target mixes with the uniform distribution
+    at weight ``label_smoothing``."""
+    if not 0.0 <= label_smoothing <= 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1], got {label_smoothing}"
+        )
+    if label_smoothing == 0.0:
+        return cross_entropy
+
+    def smoothed(outputs, targets):
+        n = outputs.shape[-1]
+        onehot = jax.nn.one_hot(targets, n, dtype=outputs.dtype)
+        soft = optax.smooth_labels(onehot, label_smoothing)
+        return jnp.mean(optax.softmax_cross_entropy(outputs, soft))
+
+    return smoothed
+
+
 def nll_loss(outputs, targets):
     """Negative log-likelihood over log-probability inputs
     (``torch.nn.NLLLoss`` semantics; ref: src/trainer.py:143-144, fixed to be
@@ -108,10 +130,21 @@ CRITERIA = {
 }
 
 
-def get_criterion(name: str) -> Callable:
+def get_criterion(name: str, label_smoothing: float = 0.0) -> Callable:
+    """Map a criterion name to its loss fn; ``label_smoothing`` (the
+    ViT/ResNet recipe) composes only with ``cross_entropy`` — criterion
+    construction and its validation live HERE, not in the trainer."""
     try:
-        return CRITERIA[name]
+        criterion = CRITERIA[name]
     except KeyError:
         raise ValueError(
             f"Unknown criterion {name!r}; expected one of {sorted(CRITERIA)}"
         ) from None
+    if label_smoothing:
+        if name != "cross_entropy":
+            raise ValueError(
+                "label_smoothing only applies to criterion='cross_entropy', "
+                f"got {name!r}"
+            )
+        return cross_entropy_smoothed(label_smoothing)
+    return criterion

@@ -173,6 +173,7 @@ class Trainer:
         early_stop_patience: Optional[int] = None,
         save_best: bool = False,
         decay_exclude_bias_norm: bool = False,
+        label_smoothing: float = 0.0,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -241,7 +242,12 @@ class Trainer:
         ``decay_exclude_bias_norm``: apply weight decay to matrices only
         (ndim >= 2), skipping biases and LayerNorm params — the standard
         transformer recipe.  Default False = torch/reference semantics
-        (decay everything)."""
+        (decay everything).
+
+        ``label_smoothing``: mix each one-hot target with the uniform
+        distribution at this weight (torch's
+        ``CrossEntropyLoss(label_smoothing=...)``; the ViT/ResNet
+        recipe).  Only valid with ``criterion='cross_entropy'``."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -373,7 +379,15 @@ class Trainer:
         logger.info(f"Training on device: {jax.default_backend()}.")
 
         self.rng = jax.random.PRNGKey(cfg.seed)
-        self.criterion = get_criterion(cfg.criterion)
+        if label_smoothing and self._takes_targets:
+            raise ValueError(
+                "label_smoothing is not supported for models that "
+                "compute their own loss (the chunked LM head applies "
+                "plain cross entropy inside the forward)"
+            )
+        self.criterion = get_criterion(
+            cfg.criterion, label_smoothing=label_smoothing
+        )
         self.pred_function = get_prediction_function(cfg.pred_function)
         self.metric_fn = get_metric(cfg.metric, self.pred_function)
         if self._takes_targets and self.metric_fn is not None:

@@ -243,3 +243,28 @@ def test_decay_mask_does_not_change_opt_state_structure():
             jax.tree_util.tree_structure(s_default)
             == jax.tree_util.tree_structure(s_masked)
         ), name
+
+
+def test_label_smoothing_matches_manual_formula():
+    """smoothed CE == (1-s)*CE(target) + s*mean-over-classes CE, i.e. the
+    cross entropy against the mixed distribution; s=0 is the plain fn."""
+    from ml_trainer_tpu.ops.losses import cross_entropy, cross_entropy_smoothed
+
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(8, 5)), jnp.float32)
+    targets = jnp.asarray(rng.integers(0, 5, 8), jnp.int32)
+    s = 0.1
+    smoothed = cross_entropy_smoothed(s)(logits, targets)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, targets[:, None], axis=-1)[:, 0]
+    manual = -jnp.mean(
+        (1 - s) * picked + (s / 5) * jnp.sum(logp, axis=-1)
+    )
+    np.testing.assert_allclose(smoothed, manual, rtol=1e-6)
+    assert cross_entropy_smoothed(0.0) is cross_entropy
+    # torch-legal degenerate bound accepted; out-of-range rejected.
+    assert np.isfinite(float(cross_entropy_smoothed(1.0)(logits, targets)))
+    with pytest.raises(ValueError, match="label_smoothing"):
+        cross_entropy_smoothed(1.5)
+    with pytest.raises(ValueError, match="cross_entropy"):
+        get_criterion("l2", label_smoothing=0.1)
