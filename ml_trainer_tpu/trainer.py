@@ -1235,22 +1235,7 @@ class Trainer:
         loss_sum = jnp.zeros(())
         metric_sum = jnp.zeros(())
         variables = self._place_eval_variables(variables)
-        d = self._data_parallel
-
-        def shardable(batch):
-            return d == 1 or batch[0].shape[0] % d == 0
-
-        def place(batch):
-            # User-built test loaders may have a ragged final batch
-            # (drop_last is their choice, ref: src/trainer.py:79 keeps all
-            # samples); replicate such batches instead of failing to split.
-            sharding = self._batch_sharding if shardable(batch) else self._replicated
-            return tuple(
-                jax.device_put(a, fit_sharding_to_rank(sharding, np.ndim(a)))
-                for a in batch
-            )
-
-        batches = map(place, test_loader)
+        batches = map(self._place_eval_batch, test_loader)
         with tqdm(batches, total=n, unit="batch") as tepoch:
             for i, (x, y) in enumerate(tepoch):
                 loss, metric_val = eval_step(variables, x, y)
@@ -1267,6 +1252,69 @@ class Trainer:
         if self.metric:
             return test_loss, float(metric_sum) / n
         return test_loss
+
+    def _place_eval_batch(self, batch):
+        """Mesh placement for one eval/predict batch.  User-built loaders
+        may have a ragged final batch (drop_last is their choice, ref:
+        src/trainer.py:79 keeps all samples); replicate those instead of
+        failing to split over the data axis — ONE rule for both APIs."""
+        d = self._data_parallel
+        sharding = (
+            self._batch_sharding
+            if d == 1 or batch[0].shape[0] % d == 0
+            else self._replicated
+        )
+        return tuple(
+            jax.device_put(a, fit_sharding_to_rank(sharding, np.ndim(a)))
+            for a in batch
+        )
+
+    def predict(self, loader, model=None, apply_pred_function: bool = True):
+        """Model outputs for every batch of ``loader``, in order — the
+        inference companion to ``test()`` (which only reports loss/metric;
+        the reference's 03-notebook flow has no outputs API at all).
+
+        ``model`` resolves exactly as in ``test()`` (None = the trained
+        state).  With ``apply_pred_function`` the trainer's configured
+        prediction function (softmax/logsoftmax/None) maps the raw
+        logits, matching what the metric engine scores.  Returns one
+        stacked numpy array [N, ...].  Loaders may yield (x, y) pairs or
+        bare x batches; labels are ignored.  Not available for self-loss
+        models (their forward returns a scalar, not outputs)."""
+        module, variables = self._resolve_model(model)
+        if _module_takes_targets(module):
+            raise ValueError(
+                "predict() needs model outputs; this model computes its "
+                "own loss (clone it with loss_chunk=0 for inference)"
+            )
+        # Same compiled-program cache as test() (module identity keyed,
+        # strong ref against id reuse) so repeat predict() calls do not
+        # retrace; apply_pred_function changes the program, so it keys.
+        key = (id(module), "predict", bool(apply_pred_function))
+        entry = self._eval_cache.get(key)
+        if entry is None or entry[0] is not module:
+            takes_train = _module_takes_train(module)
+            pred_fn = self.pred_function if apply_pred_function else None
+
+            @jax.jit
+            def forward(variables, x):
+                kwargs = {"train": False} if takes_train else {}
+                out = module.apply(variables, x, **kwargs)
+                return pred_fn(out) if pred_fn is not None else out
+
+            entry = (module, forward)
+            self._eval_cache[key] = entry
+        forward = entry[1]
+
+        variables = self._place_eval_variables(variables)
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            (x,) = self._place_eval_batch((x,))
+            outs.append(np.asarray(forward(variables, x)))
+        if not outs:
+            raise ValueError("loader yields no batches")
+        return np.concatenate(outs, axis=0)
 
     def _place_eval_variables(self, variables):
         """Mesh placement for eval/test variables: leaves already carrying a
