@@ -249,9 +249,12 @@ EXTENDED_CONFIGS = {
 }
 
 
-def bench_one_model(name: str) -> dict:
+def bench_one_model(name: str, batch_size: int | None = None) -> dict:
     """One north-star model: one full train step (bf16 compute, f32
     params), steady-state samples/sec + MFU (achieved FLOPs / chip peak).
+
+    ``batch_size`` overrides the table's leading batch dim — the MFU
+    ledger runs ResNet-50 at 32/128/256 to show where the MXU saturates.
 
     Everything device-touching is jitted: flax ``init`` executes EAGERLY
     by default — per-op dispatch, which over the remote TPU tunnel means
@@ -271,6 +274,8 @@ def bench_one_model(name: str) -> dict:
 
     bf16 = jnp.bfloat16
     shape, kind, make_kw = EXTENDED_CONFIGS[name]
+    if batch_size is not None:
+        shape = (batch_size,) + tuple(shape[1:])
     model = get_model(name, **make_kw())
     rng = np.random.default_rng(0)
     progress("transferring inputs to device")
@@ -467,10 +472,15 @@ def main():
                         help="measure BOTH dispatch paths (per-batch and "
                         "multi-step) in one session with the fenced timer "
                         "and report them side by side")
-    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--batch_size", type=int, default=None,
+                        help="override the batch size (headline MLModel "
+                        "bench defaults to 32; --one rows default to their "
+                        "EXTENDED_CONFIGS shape)")
     args = parser.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if not args.one:
+        args.batch_size = args.batch_size or 32
     if args.one:
         if not args.cpu and not args.assume_up:
             # Probe in a killable subprocess first: a wedged tunnel hangs
@@ -482,7 +492,8 @@ def main():
                     {"model": args.one, "error": f"FAILED: {note}"}
                 ), flush=True)
                 sys.exit(1)
-        print(json.dumps(bench_one_model(args.one)), flush=True)
+        print(json.dumps(bench_one_model(args.one, args.batch_size)),
+              flush=True)
         return
     if args.loaders:
         # Host-side only: measures the input pipeline, touches no device,

@@ -15,6 +15,7 @@
 // C ABI (ctypes-friendly); see ml_trainer_tpu/data/native.py for the
 // Python side.
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -55,10 +56,17 @@ struct Config {
 
 class BatchWorker {
  public:
-  BatchWorker(const uint8_t* data, const int32_t* labels, int64_t n,
-              Config cfg, int batch, int threads, int queue_cap,
+  // segs/seg_starts: the dataset's image storage as sorted segments —
+  // one for an in-RAM array, many for memory-mapped on-disk shards
+  // (ml_trainer_tpu/data/sharded.py).  The gather below gets its image
+  // pointer via segment lookup, so worker threads read mapped pages
+  // directly: the beyond-RAM streaming path IS the normal path.
+  BatchWorker(std::vector<const uint8_t*> segs,
+              std::vector<int64_t> seg_starts, const int32_t* labels,
+              int64_t n, Config cfg, int batch, int threads, int queue_cap,
               uint64_t seed)
-      : data_(data), labels_(labels), n_(n), cfg_(cfg), batch_(batch),
+      : segs_(std::move(segs)), seg_starts_(std::move(seg_starts)),
+        labels_(labels), n_(n), cfg_(cfg), batch_(batch),
         cap_(queue_cap), seed_(seed) {
     for (int t = 0; t < threads; ++t)
       team_.emplace_back([this] { Work(); });
@@ -145,7 +153,11 @@ class BatchWorker {
     Rng rng(seed_ ^ epoch_salt ^ (0x51ed2701ull * (batch_idx + 1)));
     for (int i = 0; i < batch_; ++i) {
       const int64_t src = idx[i];
-      const uint8_t* img = data_ + src * spp;
+      // Segment holding this sample: seg_starts_ is sorted, first > src.
+      const size_t seg =
+          std::upper_bound(seg_starts_.begin(), seg_starts_.end(), src) -
+          seg_starts_.begin() - 1;
+      const uint8_t* img = segs_[seg] + (src - seg_starts_[seg]) * spp;
       b.labels[i] = labels_[src];
       float* dst = b.images.data() + i * spp;
       const int oy = cfg_.pad ? static_cast<int>(rng.below(2 * cfg_.pad + 1)) : 0;
@@ -179,7 +191,8 @@ class BatchWorker {
     return b;
   }
 
-  const uint8_t* data_;
+  std::vector<const uint8_t*> segs_;
+  std::vector<int64_t> seg_starts_;
   const int32_t* labels_;
   int64_t n_;
   Config cfg_;
@@ -201,12 +214,9 @@ class BatchWorker {
 
 extern "C" {
 
-void* batch_worker_create(const uint8_t* data, const int32_t* labels,
-                          int64_t n, int height, int width, int channels,
-                          int pad, int flip, int normalize,
-                          const float* mean, const float* std_dev,
-                          int batch, int threads, int queue_cap,
-                          uint64_t seed) {
+static Config make_config(int height, int width, int channels, int pad,
+                          int flip, int normalize, const float* mean,
+                          const float* std_dev) {
   Config cfg{};
   cfg.height = height;
   cfg.width = width;
@@ -218,8 +228,29 @@ void* batch_worker_create(const uint8_t* data, const int32_t* labels,
     cfg.mean[i] = mean ? mean[i] : 0.0f;
     cfg.std_[i] = std_dev ? std_dev[i] : 1.0f;
   }
-  return new BatchWorker(data, labels, n, cfg, batch, threads, queue_cap,
-                         seed);
+  return cfg;
+}
+
+// Images arrive as num_segs memory-mapped (or in-RAM) segments;
+// seg_starts[i] is the first global sample index of segment i (sorted,
+// seg_starts[0] == 0).  An in-RAM ArrayDataset is simply the one-segment
+// case.  Labels stay one in-RAM array — at 4 bytes/sample they are never
+// the residency problem.
+void* batch_worker_create_sharded(const uint8_t** seg_ptrs,
+                                  const int64_t* seg_starts,
+                                  int64_t num_segs, const int32_t* labels,
+                                  int64_t n, int height, int width,
+                                  int channels, int pad, int flip,
+                                  int normalize, const float* mean,
+                                  const float* std_dev, int batch,
+                                  int threads, int queue_cap,
+                                  uint64_t seed) {
+  return new BatchWorker(
+      std::vector<const uint8_t*>(seg_ptrs, seg_ptrs + num_segs),
+      std::vector<int64_t>(seg_starts, seg_starts + num_segs), labels, n,
+      make_config(height, width, channels, pad, flip, normalize, mean,
+                  std_dev),
+      batch, threads, queue_cap, seed);
 }
 
 void batch_worker_start_epoch(void* worker, const int64_t* indices,

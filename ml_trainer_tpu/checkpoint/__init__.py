@@ -1,12 +1,14 @@
 from ml_trainer_tpu.checkpoint.checkpoint import (
     CHECKPOINT_PREFIX,
     MODEL_FILE,
+    checkpoint_format,
     fetch_to_host,
     latest_checkpoint,
     load_model_variables,
     prune_checkpoints,
     restore_checkpoint,
     save_checkpoint,
+    save_checkpoint_sharded,
     save_model_variables,
     write_model_bytes,
     wait_for_checkpoints,
@@ -16,12 +18,14 @@ from ml_trainer_tpu.checkpoint.torch_import import load_torch_checkpoint
 __all__ = [
     "CHECKPOINT_PREFIX",
     "MODEL_FILE",
+    "checkpoint_format",
     "fetch_to_host",
     "latest_checkpoint",
     "load_model_variables",
     "prune_checkpoints",
     "restore_checkpoint",
     "save_checkpoint",
+    "save_checkpoint_sharded",
     "save_model_variables",
     "write_model_bytes",
     "wait_for_checkpoints",

@@ -152,10 +152,18 @@ def fetch_to_host(tree: Any) -> Any:
 
     ``jax.device_get`` raises on arrays that are not fully addressable
     (e.g. ZeRO-1 optimizer moments sharded over a multi-host ``data``
-    axis); those leaves are gathered across processes first.  Single-host
-    arrays take the plain fast path."""
+    axis); those are gathered across processes.  Fully-REPLICATED
+    multi-host leaves (params under pure DP) read their local replica
+    instead: no collective — which also means a host can export weights
+    while other hosts sit in an unrelated barrier (process_allgather
+    launches a global computation, so a host-0-only call would otherwise
+    deadlock against any concurrent collective; observed exactly so with
+    the v3 commit barrier).  Single-host arrays take the plain fast
+    path."""
     def fetch(leaf):
         if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            if getattr(leaf, "is_fully_replicated", False):
+                return np.asarray(leaf.addressable_data(0))
             from jax.experimental import multihost_utils
 
             return np.asarray(
@@ -200,16 +208,284 @@ def save_checkpoint(
     return path
 
 
+# ------------------------------------------------------- v3 sharded format
+# Each process writes exactly its addressable shards — no process ever
+# gathers (or even holds) the full state tree, which is what makes
+# GPT-2-class ZeRO-1/TP states checkpointable without the host-0 RAM
+# spike + DCN allgather of ``fetch_to_host``.  Layout of
+# ``checkpoint_<epoch>/``:
+#
+#   leaf_<i>_s<j>_p<proc>.npy   one saved piece (a device shard) of leaf i
+#   manifest_p<proc>.json       piece table of process <proc>
+#   manifest.json               commit marker, written LAST by process 0:
+#                               format=3, epoch, history, leaf tree
+#                               (paths + global shapes + dtypes)
+#
+# The format assumes the checkpoint directory is shared storage (GCS/NFS —
+# the normal TPU-pod setup, and the reason restore can stitch every
+# process's pieces).  Restore reads each leaf back either as a full host
+# array (shardings=None) or directly into a sharded ``jax.Array`` via
+# ``make_array_from_callback`` — each device materializes only its own
+# slice, stitched from whatever saved pieces intersect it, so a checkpoint
+# written on mesh A restores onto a DIFFERENT mesh B (elastic resume: the
+# piece grid and the target shard grid need not match).
+
+
+def _piece_entries(leaf) -> Optional[list]:
+    """The (index, data) pieces THIS process must write for a leaf, or
+    None when the leaf is a host-side value (process 0 writes those whole).
+    Replicated shards are deduped by ``replica_id == 0`` — exactly one
+    process in the cluster owns each distinct piece."""
+    if not hasattr(leaf, "addressable_shards"):
+        return None
+    out = []
+    for shard in leaf.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        out.append((shard.index, np.asarray(shard.data)))
+    return out
+
+
+def _index_bounds(index, shape) -> Tuple[list, list]:
+    """Normalize a shard index (tuple of slices) to explicit start/stop."""
+    starts, stops = [], []
+    for sl, dim in zip(index, shape):
+        lo, hi, step = sl.indices(dim)
+        assert step == 1, f"strided shard index {sl} unsupported"
+        starts.append(lo)
+        stops.append(hi)
+    return starts, stops
+
+
+def save_checkpoint_sharded(
+    ckpt_dir: str,
+    state: Any,
+    history: dict,
+    epoch: int,
+    keep: int = 3,
+    block: bool = True,
+) -> str:
+    """Write ``checkpoint_<epoch>/`` with every process contributing its
+    addressable shards (format v3).  COLLECTIVE: every process must call
+    it (there is a cross-process barrier before the commit marker).
+
+    ``block=False`` keeps only the disk writes off the training thread —
+    the device→host shard snapshot is synchronous regardless (the compiled
+    step donates state buffers).  In a multi-process cluster the call is
+    forced synchronous: the commit barrier is a collective, and collectives
+    must not run on a background thread concurrently with the training
+    step's.
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    proc = jax.process_index()
+    nproc = jax.process_count()
+    if nproc > 1:
+        block = True
+    state_dict = serialization.to_state_dict(state)
+    final_dir = os.path.join(ckpt_dir, f"{CHECKPOINT_PREFIX}{epoch}")
+    history = copy.deepcopy(history)
+
+    # Snapshot (synchronously) the pieces this process owns, and — on
+    # process 0 — the leaf-tree metadata for the commit manifest.
+    my_pieces: list = []   # (leaf_id, [(starts, stops, np.ndarray), ...])
+    leaf_meta: list = []
+    for i, (path, leaf) in enumerate(_flatten(state_dict)):
+        if isinstance(leaf, dict):
+            leaf_meta.append({"path": list(path), "empty": True})
+            continue
+        if leaf is None:
+            leaf_meta.append({"path": list(path), "none": True})
+            continue
+        pieces = _piece_entries(leaf)
+        if pieces is None:  # host-side scalar/ndarray: process 0 owns it
+            arr = np.asarray(leaf)
+            pieces = (
+                [(tuple(slice(0, d) for d in arr.shape), arr)]
+                if proc == 0 else []
+            )
+            shape, dtype = arr.shape, arr.dtype
+        else:
+            shape, dtype = leaf.shape, leaf.dtype
+        leaf_meta.append({
+            "path": list(path),
+            "shape": list(shape),
+            "dtype": np.dtype(dtype).name,
+        })
+        entries = []
+        for j, (index, data) in enumerate(pieces):
+            starts, stops = _index_bounds(index, shape)
+            entries.append((j, starts, stops, data))
+        if entries:
+            my_pieces.append((i, entries))
+
+    def write_files():
+        os.makedirs(final_dir, exist_ok=True)
+        table = []
+        for leaf_id, entries in my_pieces:
+            for j, starts, stops, data in entries:
+                fname = f"leaf_{leaf_id:05d}_s{j}_p{proc:05d}.npy"
+                np.save(
+                    os.path.join(final_dir, fname), data, allow_pickle=False
+                )
+                table.append({
+                    "leaf": leaf_id, "file": fname,
+                    "start": starts, "stop": stops,
+                })
+        _atomic_write(
+            os.path.join(final_dir, f"manifest_p{proc:05d}.json"),
+            json.dumps({"process": proc, "pieces": table}).encode(),
+        )
+
+    def commit():
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            # Every process's shard files + piece table are on disk
+            # before the marker makes the checkpoint discoverable.
+            multihost_utils.sync_global_devices(f"ckpt_v3_commit_{epoch}")
+        if proc == 0:
+            _atomic_write(
+                os.path.join(final_dir, MANIFEST),
+                json.dumps({
+                    "format": 3,
+                    "epoch": epoch,
+                    "history": history,
+                    "process_count": nproc,
+                    "leaves": leaf_meta,
+                }).encode(),
+            )
+            prune_checkpoints(ckpt_dir, keep)
+
+    if block:
+        write_files()
+        commit()
+    else:
+        fut = _writer.submit(lambda: (write_files(), commit()))
+        with _pending_lock:
+            _pending.append(fut)
+    return final_dir
+
+
+def _read_piece_tables(path: str, nproc: Optional[int] = None) -> dict:
+    """leaf_id -> [(starts, stops, file)] over the piece tables of
+    processes [0, nproc).  ``nproc`` comes from the COMMIT manifest: an
+    interrupted earlier save by a larger cluster can leave stale
+    ``manifest_p*``/piece files in the same directory (the fresh save
+    atomically overwrites the indices it reuses but cannot know about
+    higher ones), and merging those would silently corrupt the restore —
+    last-writer-wins in ``_stitch``.  Stale piece FILES are harmless:
+    only files referenced by a read table are ever opened."""
+    tables: dict = {}
+    names = (
+        [
+            n for n in sorted(os.listdir(path))
+            if n.startswith("manifest_p") and n.endswith(".json")
+        ]
+        if nproc is None
+        else [f"manifest_p{p:05d}.json" for p in range(nproc)]
+    )
+    for name in names:
+        with open(os.path.join(path, name)) as fp:
+            for e in json.load(fp)["pieces"]:
+                tables.setdefault(e["leaf"], []).append(
+                    (e["start"], e["stop"], e["file"])
+                )
+    return tables
+
+
+def _stitch(path, pieces, starts, stops, shape, dtype):
+    """Materialize the [starts, stops) sub-box of a leaf from the saved
+    pieces that intersect it.  Pieces are read through ``np.load``
+    memmaps, so only the intersecting pages come off storage — a device
+    restoring 1/Nth of a leaf reads ~1/Nth of its bytes."""
+    box = np.empty(
+        [hi - lo for lo, hi in zip(starts, stops)], dtype=dtype
+    )
+    filled = np.zeros(box.shape, dtype=bool)
+    for p_starts, p_stops, fname in pieces:
+        inter_lo = [max(a, b) for a, b in zip(starts, p_starts)]
+        inter_hi = [min(a, b) for a, b in zip(stops, p_stops)]
+        if any(lo >= hi for lo, hi in zip(inter_lo, inter_hi)):
+            continue
+        src = np.load(
+            os.path.join(path, fname), allow_pickle=False, mmap_mode="r"
+        )
+        src_sel = tuple(
+            slice(lo - plo, hi - plo)
+            for lo, hi, plo in zip(inter_lo, inter_hi, p_starts)
+        )
+        dst_sel = tuple(
+            slice(lo - blo, hi - blo)
+            for lo, hi, blo in zip(inter_lo, inter_hi, starts)
+        )
+        box[dst_sel] = src[src_sel]
+        filled[dst_sel] = True
+    if not np.all(filled):
+        raise ValueError(
+            f"checkpoint pieces do not cover [{starts}, {stops}) of a "
+            f"{shape} leaf — incomplete or corrupt v3 checkpoint"
+        )
+    return box
+
+
+def _restore_v3(path: str, manifest: dict, state_template: Any, shardings):
+    tables = _read_piece_tables(path, manifest.get("process_count"))
+    shard_leaves = (
+        None if shardings is None
+        else {
+            tuple(str(k) for k in p): s
+            for p, s in _flatten(serialization.to_state_dict(shardings))
+        }
+    )
+    pairs = []
+    for i, meta in enumerate(manifest["leaves"]):
+        lpath = tuple(meta["path"])
+        if meta.get("empty"):
+            pairs.append((lpath, {}))
+            continue
+        if meta.get("none"):
+            pairs.append((lpath, None))
+            continue
+        shape = tuple(meta["shape"])
+        dtype = np.dtype(meta["dtype"])
+        pieces = tables.get(i, [])
+        sharding = shard_leaves.get(lpath) if shard_leaves else None
+        if sharding is not None and isinstance(
+            sharding, jax.sharding.Sharding
+        ):
+            def cb(index, _pieces=pieces, _shape=shape, _dtype=dtype):
+                starts, stops = _index_bounds(index, _shape)
+                return _stitch(path, _pieces, starts, stops, _shape, _dtype)
+
+            leaf = jax.make_array_from_callback(shape, sharding, cb)
+        else:
+            leaf = _stitch(
+                path, pieces, [0] * len(shape), list(shape), shape, dtype
+            )
+        pairs.append((lpath, leaf))
+    state = _from_state_dict_compat(state_template, _unflatten(pairs))
+    return state, manifest["history"], manifest["epoch"]
+
+
 def _scan_checkpoints(ckpt_dir: str):
-    """Sorted (epoch, filename) pairs of checkpoints (v2 dirs + v1 pkls).
-    In-flight ``.tmp`` dirs are skipped."""
+    """Sorted (epoch, filename) pairs of checkpoints (v1 pkls, v2 dirs,
+    v3 sharded dirs).  In-flight ``.tmp`` dirs are skipped, and so are
+    directories without a committed ``manifest.json`` — a v3 save writes
+    shard files first and the manifest LAST (the commit marker), so an
+    interrupted multi-process save never looks like a valid checkpoint."""
     if not os.path.isdir(ckpt_dir):
         return []
     found = []
     for name in os.listdir(ckpt_dir):
         m = _CKPT_RE.match(name)
-        if m:
-            found.append((int(m.group(1)), name))
+        if not m:
+            continue
+        full = os.path.join(ckpt_dir, name)
+        if os.path.isdir(full) and not os.path.exists(
+            os.path.join(full, MANIFEST)
+        ):
+            continue
+        found.append((int(m.group(1)), name))
     return sorted(found)
 
 
@@ -229,6 +505,14 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     if not found:
         return None
     return os.path.join(ckpt_dir, found[-1][1])
+
+
+def checkpoint_format(path: str) -> int:
+    """1 (legacy pickle), 2 (per-leaf dir), or 3 (per-host sharded)."""
+    if not os.path.isdir(path):
+        return 1
+    with open(os.path.join(path, MANIFEST)) as fp:
+        return int(json.load(fp).get("format", 2))
 
 
 def _reconcile_ema(state_template: Any, saved: Any) -> Any:
@@ -322,12 +606,23 @@ def _from_state_dict_compat(state_template: Any, saved: Any) -> Any:
         raise orig
 
 
-def restore_checkpoint(path: str, state_template: Any) -> Tuple[Any, dict, int]:
+def restore_checkpoint(
+    path: str, state_template: Any, shardings: Any = None
+) -> Tuple[Any, dict, int]:
     """Restore (state, history, epoch); the template supplies pytree
-    structure (the trainer always has one before restoring)."""
+    structure (the trainer always has one before restoring).
+
+    ``shardings`` (a pytree of ``NamedSharding`` matching the state, or
+    None) applies to v3 sharded checkpoints: each leaf is built directly
+    as a sharded ``jax.Array`` on the target mesh — which may differ from
+    the mesh that wrote the checkpoint (elastic resume).  v1/v2
+    checkpoints ignore it and return host arrays (the caller re-places
+    them, which equally works across meshes — every leaf is full there)."""
     if os.path.isdir(path):
         with open(os.path.join(path, MANIFEST)) as fp:
             manifest = json.load(fp)
+        if manifest.get("format") == 3:
+            return _restore_v3(path, manifest, state_template, shardings)
         pairs = [
             (
                 tuple(leaf["path"]),

@@ -13,6 +13,10 @@ from ml_trainer_tpu.data.datasets import (
 )
 from ml_trainer_tpu.data.loader import Loader, prefetch_to_device
 from ml_trainer_tpu.data.sampler import ShardedSampler
+from ml_trainer_tpu.data.sharded import (
+    ShardedImageDataset,
+    write_sharded_dataset,
+)
 from ml_trainer_tpu.data.text import (
     PackedLMDataset,
     TokenizedDataset,
@@ -37,6 +41,8 @@ __all__ = [
     "Loader",
     "prefetch_to_device",
     "ShardedSampler",
+    "ShardedImageDataset",
+    "write_sharded_dataset",
     "PackedLMDataset",
     "TokenizedDataset",
     "load_sst2_tsv",

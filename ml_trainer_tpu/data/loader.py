@@ -86,7 +86,9 @@ class Loader:
         n_batches = len(self)
         transform = getattr(self.dataset, "transform", None)
         rng = np.random.default_rng((self.seed, 1 + self._epoch))
-        fast = isinstance(self.dataset, ArrayDataset)
+        # Vectorized-gather path: ArrayDataset and the memory-mapped
+        # ShardedImageDataset both expose batch(indices).
+        fast = hasattr(self.dataset, "batch")
         for b in range(n_batches):
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
             if fast:

@@ -29,12 +29,17 @@ _lib_lock = threading.Lock()
 
 def _build_library() -> str:
     src = os.path.join(_CSRC, "batch_worker.cpp")
+    # Compile to a private temp path, then atomically publish: concurrent
+    # processes (parallel pytest, multi-process workers) may rebuild at
+    # the same time, and one must never dlopen a half-written .so.
+    tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     subprocess.run(
         ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-Wall", "-shared",
-         "-o", _LIB_PATH, src],
+         "-o", tmp, src],
         check=True,
         capture_output=True,
     )
+    os.replace(tmp, _LIB_PATH)
     return _LIB_PATH
 
 
@@ -43,12 +48,17 @@ def load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_CSRC, "batch_worker.cpp")
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.exists(src)
+            and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        ):
             _build_library()
         lib = ctypes.CDLL(_LIB_PATH)
-        lib.batch_worker_create.restype = ctypes.c_void_p
-        lib.batch_worker_create.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        lib.batch_worker_create_sharded.restype = ctypes.c_void_p
+        lib.batch_worker_create_sharded.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
@@ -91,12 +101,22 @@ def native_plan(dataset) -> Optional[dict]:
         ToFloat,
     )
 
+    from ml_trainer_tpu.data.sharded import ShardedImageDataset
+
     data = getattr(dataset, "data", None)
-    if not (
+    if isinstance(dataset, ShardedImageDataset):
+        # Memory-mapped shards: the native worker gathers from the mapped
+        # segments directly (the beyond-RAM path).
+        if len(dataset.shape) != 3:
+            return None
+        h, w = dataset.shape[0], dataset.shape[1]
+    elif (
         isinstance(data, np.ndarray)
         and data.dtype == np.uint8
         and data.ndim == 4
     ):
+        h, w = data.shape[1], data.shape[2]
+    else:
         return None
     t = getattr(dataset, "transform", None)
     if t is None:
@@ -104,7 +124,7 @@ def native_plan(dataset) -> Optional[dict]:
     ts = list(t.transforms) if isinstance(t, Compose) else [t]
     i, pad, flip = 0, 0, False
     if i < len(ts) and isinstance(ts[i], RandomCrop):
-        if ts[i].size != data.shape[1] or data.shape[1] != data.shape[2]:
+        if ts[i].size != h or h != w:
             return None
         pad, i = ts[i].padding, i + 1
     if i < len(ts) and isinstance(ts[i], RandomHorizontalFlip):
@@ -146,8 +166,8 @@ class NativeLoader:
         seed: int = 0,
         drop_last: bool = True,
     ):
-        if dataset.data.dtype != np.uint8 or dataset.data.ndim != 4:
-            raise ValueError("NativeLoader requires uint8 NHWC image data")
+        from ml_trainer_tpu.data.sharded import ShardedImageDataset
+
         self.dataset = dataset
         self.batch_size = int(batch_size)
         self.shuffle = shuffle
@@ -155,9 +175,26 @@ class NativeLoader:
         self.seed = seed
         self.drop_last = drop_last
         self._epoch = 0
-        self._data = np.ascontiguousarray(dataset.data)
+        if isinstance(dataset, ShardedImageDataset):
+            # Beyond-RAM path: the worker gathers straight from the
+            # memory-mapped shard segments — the dataset is never copied
+            # into process RAM.  (np.ascontiguousarray on a C-contiguous
+            # memmap is a no-copy passthrough; keep references so the
+            # mappings outlive the C++ worker.)
+            if len(dataset.shape) != 3:
+                raise ValueError("NativeLoader requires uint8 NHWC images")
+            self._segments = [
+                np.ascontiguousarray(m) for m in dataset.shard_maps
+            ]
+            h, w, c = dataset.shape
+            seg_starts = dataset.shard_starts[:-1]
+        else:
+            if dataset.data.dtype != np.uint8 or dataset.data.ndim != 4:
+                raise ValueError("NativeLoader requires uint8 NHWC image data")
+            self._segments = [np.ascontiguousarray(dataset.data)]
+            _, h, w, c = self._segments[0].shape
+            seg_starts = [0]
         self._labels = np.ascontiguousarray(dataset.targets.astype(np.int32))
-        _, h, w, c = self._data.shape
         self._shape = (h, w, c)
         if normalize is None:
             from ml_trainer_tpu.utils.functions import CIFAR10_MEAN, CIFAR10_STD
@@ -167,8 +204,15 @@ class NativeLoader:
         std = (ctypes.c_float * c)(*normalize[1][:c])
         lib = load_library()
         self._lib = lib
-        self._handle = lib.batch_worker_create(
-            self._data.ctypes.data_as(ctypes.c_void_p),
+        n_segs = len(self._segments)
+        seg_ptrs = (ctypes.c_void_p * n_segs)(
+            *[s.ctypes.data for s in self._segments]
+        )
+        starts = (ctypes.c_int64 * n_segs)(*[int(s) for s in seg_starts])
+        self._handle = lib.batch_worker_create_sharded(
+            ctypes.cast(seg_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+            ctypes.cast(starts, ctypes.POINTER(ctypes.c_int64)),
+            n_segs,
             self._labels.ctypes.data_as(ctypes.c_void_p),
             len(dataset), h, w, c, pad, int(flip), 1, mean, std,
             self.batch_size, num_threads, queue_cap, seed + 1,

@@ -1,0 +1,151 @@
+"""Beyond-RAM dataset: sharded on-disk ``.npy`` images, memory-mapped.
+
+The reference's DataLoader role (ref: src/dataloader.py:5 — arbitrary
+dataset objects through a worker pool) covers datasets that do not fit in
+host RAM; the in-memory ``ArrayDataset`` does not.  This module adds the
+ImageNet-class path (BASELINE.json configs[1]): images live in per-shard
+``.npy`` files and are **memory-mapped**, so batch gathers fault in only
+the pages they touch and the OS page cache — not the Python process —
+decides residency.  Labels (4 bytes/sample) stay in RAM.
+
+Layout of a dataset directory::
+
+    index.json                {"shards": [{"x": ..., "y": ..., "n": ...}],
+                               "shape": [H, W, C], "total": N}
+    shard_00000_x.npy         [n, H, W, C] uint8 images
+    shard_00000_y.npy         [n] int32 labels
+    ...
+
+Both loaders consume it: the Python ``Loader`` through ``batch()``
+(per-shard fancy-indexing into the maps), and the C++ ``NativeLoader``
+through a shard pointer table (csrc/batch_worker.cpp gathers straight
+from the mapped pages on its worker threads — sustained prefetch with no
+copy of the dataset into RAM).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.data.datasets import Dataset
+from ml_trainer_tpu.data.transforms import Transform
+
+INDEX_FILE = "index.json"
+
+
+def write_sharded_dataset(
+    root: str,
+    batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+    samples_per_shard: int = 8192,
+) -> str:
+    """Write an iterable of (images [n,H,W,C] uint8, labels [n]) chunks as
+    a sharded dataset under ``root``.  Chunks are re-chunked to exactly
+    ``samples_per_shard`` per shard (last shard ragged), so the writer
+    itself is streaming: peak RAM is one shard, regardless of dataset
+    size."""
+    os.makedirs(root, exist_ok=True)
+    shards, shape = [], None
+    buf_x: list = []
+    buf_y: list = []
+    buffered = 0
+
+    def flush(n):
+        nonlocal buffered
+        cat_x, cat_y = np.concatenate(buf_x), np.concatenate(buf_y)
+        x, rest_x = cat_x[:n], cat_x[n:]
+        y, rest_y = cat_y[:n].astype(np.int32), cat_y[n:]
+        i = len(shards)
+        fx, fy = f"shard_{i:05d}_x.npy", f"shard_{i:05d}_y.npy"
+        np.save(os.path.join(root, fx), np.ascontiguousarray(x),
+                allow_pickle=False)
+        np.save(os.path.join(root, fy), y, allow_pickle=False)
+        shards.append({"x": fx, "y": fy, "n": int(n)})
+        buf_x[:] = [rest_x] if len(rest_x) else []
+        buf_y[:] = [rest_y] if len(rest_y) else []
+        buffered = len(rest_x)
+
+    for x, y in batches:
+        x, y = np.asarray(x), np.asarray(y)
+        if x.dtype != np.uint8:
+            raise ValueError(f"images must be uint8, got {x.dtype}")
+        if shape is None:
+            shape = x.shape[1:]
+        elif tuple(x.shape[1:]) != tuple(shape):
+            raise ValueError(f"chunk shape {x.shape[1:]} != first {shape}")
+        buf_x.append(x)
+        buf_y.append(y)
+        buffered += len(x)
+        while buffered >= samples_per_shard:
+            flush(samples_per_shard)
+    if buffered:
+        flush(buffered)
+    index = {
+        "shards": shards,
+        "shape": list(shape) if shape is not None else [],
+        "total": int(sum(s["n"] for s in shards)),
+    }
+    with open(os.path.join(root, INDEX_FILE), "w") as fp:
+        json.dump(index, fp)
+    return root
+
+
+class ShardedImageDataset(Dataset):
+    """Memory-mapped sharded image dataset (see module docstring).
+
+    Satisfies the ``Dataset`` protocol plus the Loader's fast
+    ``batch(indices)`` path; ``shard_maps``/``shard_starts`` expose the
+    mapped segments for the native worker's pointer table."""
+
+    def __init__(self, root: str, transform: Optional[Transform] = None):
+        with open(os.path.join(root, INDEX_FILE)) as fp:
+            index = json.load(fp)
+        self.root = root
+        self.transform = transform
+        self.shape = tuple(index["shape"])
+        self.total = int(index["total"])
+        # mmap_mode='r': mapping is O(1) — no bytes are read until touched.
+        self.shard_maps = [
+            np.load(os.path.join(root, s["x"]), mmap_mode="r",
+                    allow_pickle=False)
+            for s in index["shards"]
+        ]
+        for m, s in zip(self.shard_maps, index["shards"]):
+            if m.dtype != np.uint8 or tuple(m.shape[1:]) != self.shape:
+                raise ValueError(
+                    f"shard {s['x']}: {m.dtype} {m.shape} does not match "
+                    f"index uint8 {self.shape}"
+                )
+        counts = np.asarray([s["n"] for s in index["shards"]], np.int64)
+        # shard_starts[i] = first global index of shard i (+ total sentinel).
+        self.shard_starts = np.concatenate([[0], np.cumsum(counts)])
+        # Labels are tiny — hold them in RAM as one array.
+        self.targets = np.concatenate([
+            np.load(os.path.join(root, s["y"]), allow_pickle=False)
+            for s in index["shards"]
+        ]).astype(np.int32)
+        assert len(self.targets) == self.total, (len(self.targets), self.total)
+
+    def __len__(self) -> int:
+        return self.total
+
+    def __getitem__(self, idx: int):
+        s = int(np.searchsorted(self.shard_starts, idx, "right") - 1)
+        return (
+            np.asarray(self.shard_maps[s][idx - self.shard_starts[s]]),
+            self.targets[idx],
+        )
+
+    def batch(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Batched gather across the maps — the Loader's hot path.  One
+        fancy-index per touched shard; only the touched pages fault in."""
+        indices = np.asarray(indices)
+        out = np.empty((len(indices),) + self.shape, np.uint8)
+        shard_of = np.searchsorted(self.shard_starts, indices, "right") - 1
+        for s in np.unique(shard_of):
+            rows = shard_of == s
+            out[rows] = self.shard_maps[s][indices[rows] - self.shard_starts[s]]
+        return out, self.targets[indices]

@@ -146,6 +146,13 @@ def generate_ragged(
         by_len.setdefault(p.shape[0], []).append((i, p))
     out: list = [None] * len(prompts)
     rng = kwargs.pop("rng", None)
+    if rng is None and kwargs.get("temperature", 0.0) != 0.0:
+        # Without this, every bucket would fall through to generate()'s
+        # own PRNGKey(0) default and draw with identical key sequences —
+        # correlated samples across buckets, contradicting the
+        # independence promise above.  Materialize the same default HERE
+        # so the per-bucket fold_in below always applies.
+        rng = jax.random.PRNGKey(0)
     for length, group in sorted(by_len.items()):
         idx, rows = zip(*group)
         batch = jnp.stack(rows)

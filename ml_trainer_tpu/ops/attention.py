@@ -134,7 +134,11 @@ def _flash_kernel(*refs, block_k: int, causal: bool, scale: float,
         o_ref[0] = (o_scr[:] / l_scr[:]).astype(o_ref.dtype)
         # Per-row logsumexp of the scaled scores — the only softmax
         # statistic the flash backward needs (FlashAttention-2 style).
-        lse_ref[0] = (m_scr[:] + jnp.log(l_scr[:]))[:, 0]
+        # Written as a [block_q, 1] column: a trailing singleton dim is
+        # exempt from Mosaic's (8, 128) block-tiling rule, whereas a
+        # [1, block_q] row block is rejected by the compiled lowering
+        # (interpret mode never checks this).
+        lse_ref[0] = m_scr[:] + jnp.log(l_scr[:])
 
 
 def _lens_per_bh(kv_lens, b, h):
@@ -177,12 +181,12 @@ def _flash_forward(q, k, v, kv_lens, *, causal, scale, block_q, block_k,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda i, j, kv: (i, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda i, j, kv: (i, j),
+            pl.BlockSpec((1, block_q, 1), lambda i, j, kv: (i, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_q, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, s_q), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, s_q, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
@@ -192,6 +196,11 @@ def _flash_forward(q, k, v, kv_lens, *, causal, scale, block_q, block_k,
         interpret=interpret,
     )(*operands)
     return out.reshape(b, h, s_q, d), lse.reshape(b, h, s_q)
+
+
+# Per-row statistics (lse, delta) travel through the backward kernels as
+# [B*H, S, 1] columns with (1, block, 1) blocks for the same Mosaic
+# block-tiling reason documented in _flash_kernel's finalize.
 
 
 def _keep_mask(p_shape, q_start, kv_start, kv_len, causal, masked):
@@ -245,8 +254,8 @@ def _flash_bwd_dq_kernel(*refs, block_k: int, causal: bool, scale: float,
         kk = k_ref[0].astype(jnp.float32)
         vv = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]          # [block_q, 1]
-        delta = delta_ref[0][:, None]      # [block_q, 1]
+        lse = lse_ref[0]                   # [block_q, 1]
+        delta = delta_ref[0]               # [block_q, 1]
         scores = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -309,8 +318,8 @@ def _flash_bwd_dkv_kernel(*refs, block_q: int, causal: bool, scale: float,
         kk = k_ref[0].astype(jnp.float32)
         vv = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0]                   # [block_q, 1]
+        delta = delta_ref[0]               # [block_q, 1]
         scores = jax.lax.dot_general(
             q, kk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -352,12 +361,12 @@ def _flash_backward(q, k, v, kv_lens, out, lse, g, *, causal, scale, block_q,
     kr = k.reshape(b * h, s_k, d)
     vr = v.reshape(b * h, s_k, d)
     dor = g.reshape(b * h, s_q, d)
-    lser = lse.reshape(b * h, s_q)
+    lser = lse.reshape(b * h, s_q, 1)
     # delta_i = rowsum(dO_i * O_i) — a cheap elementwise reduce; let XLA
     # fuse it rather than adding a third kernel pass.
     delta = jnp.sum(
         dor.astype(jnp.float32) * out.reshape(b * h, s_q, d).astype(jnp.float32),
-        axis=-1,
+        axis=-1, keepdims=True,
     )
     nq, nkv = pl.cdiv(s_q, block_q), pl.cdiv(s_k, block_k)
     masked = kv_lens is not None
@@ -371,7 +380,7 @@ def _flash_backward(q, k, v, kv_lens, out, lse, g, *, causal, scale, block_q,
                          memory_space=pltpu.VMEM)
     kvspec_stream = pl.BlockSpec((1, block_k, d), lambda i, j, x: (i, x, 0),
                                  memory_space=pltpu.VMEM)
-    rowspec = pl.BlockSpec((1, block_q), lambda i, j, x: (i, j),
+    rowspec = pl.BlockSpec((1, block_q, 1), lambda i, j, x: (i, j, 0),
                            memory_space=pltpu.VMEM)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, block_k=block_k,
@@ -389,7 +398,7 @@ def _flash_backward(q, k, v, kv_lens, out, lse, g, *, causal, scale, block_q,
                           memory_space=pltpu.VMEM)
     qspec_stream = pl.BlockSpec((1, block_q, d), lambda i, j, x: (i, x, 0),
                                 memory_space=pltpu.VMEM)
-    rowspec_stream = pl.BlockSpec((1, block_q), lambda i, j, x: (i, x),
+    rowspec_stream = pl.BlockSpec((1, block_q, 1), lambda i, j, x: (i, x, 0),
                                   memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, block_q=block_q,

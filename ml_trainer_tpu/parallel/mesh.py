@@ -70,27 +70,32 @@ def default_mesh() -> Mesh:
 def _split_dcn(axes, dims, dcn_axes, num_slices):
     """Factor the slice count out of the mesh dims.
 
-    The slice count lands on the FIRST (outermost) dcn axis divisible by
-    it; that axis keeps its intra-slice remainder on ICI — e.g. 2 slices
-    x 16 chips with axes data=8, tensor=4 becomes dcn data=2, ici
-    data=4, ici tensor=4.  (mesh_utils requires prod(dcn_mesh_shape) ==
-    num_slices exactly.)  Returns (ici_dims, dcn_dims), elementwise
-    product == dims."""
+    Slices are factored greedily across the dcn axes, outermost first:
+    each dcn axis absorbs ``gcd(axis_size, slices_left)`` slices and
+    keeps its intra-slice remainder on ICI — e.g. 2 slices x 16 chips
+    with axes data=8, tensor=4 becomes dcn data=2, ici data=4, ici
+    tensor=4; and 4 slices with data=2, fsdp=2 and
+    dcn_axes=('data','fsdp') becomes dcn (2, 2), ici (1, 1).
+    (mesh_utils requires prod(dcn_mesh_shape) == num_slices exactly.)
+    Returns (ici_dims, dcn_dims), elementwise product == dims."""
+    import math
+
     ici, dcn = [], []
     slices_left = num_slices
     for a, size in zip(axes, dims):
-        if a in dcn_axes and slices_left > 1 and size % slices_left == 0:
-            dcn.append(slices_left)
-            ici.append(size // slices_left)
-            slices_left = 1
+        if a in dcn_axes and slices_left > 1:
+            g = math.gcd(size, slices_left)
+            dcn.append(g)
+            ici.append(size // g)
+            slices_left //= g
         else:
             dcn.append(1)
             ici.append(size)
     if slices_left > 1:
         raise ValueError(
             f"mesh dims {dict(zip(axes, dims))} cannot span {num_slices} "
-            f"slices: no axis in dcn_axes={tuple(dcn_axes)} is divisible "
-            "by the slice count"
+            f"slices: the axes in dcn_axes={tuple(dcn_axes)} only absorb "
+            f"{num_slices // slices_left} of them"
         )
     return ici, dcn
 

@@ -174,6 +174,7 @@ class Trainer:
         save_best: bool = False,
         decay_exclude_bias_norm: bool = False,
         label_smoothing: float = 0.0,
+        sharded_checkpoint: Optional[bool] = None,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -247,7 +248,21 @@ class Trainer:
         ``label_smoothing``: mix each one-hot target with the uniform
         distribution at this weight (torch's
         ``CrossEntropyLoss(label_smoothing=...)``; the ViT/ResNet
-        recipe).  Only valid with ``criterion='cross_entropy'``."""
+        recipe).  Only valid with ``criterion='cross_entropy'``.
+
+        ``sharded_checkpoint``: write full-state checkpoints in the
+        per-host sharded format — every process saves exactly its
+        addressable shards (ZeRO-1 moments, TP/FSDP params) instead of
+        host 0 allgathering the full tree.  Restore stitches shards back
+        per-device, including onto a DIFFERENT mesh/device count than the
+        one that saved (elastic resume after preemption).  Requires the
+        model_dir to be storage shared by all hosts.  Default ``None`` =
+        auto: on whenever the run is multi-process AND the state has
+        genuinely partitioned leaves — the combination where a host-0
+        full-tree gather is not just a RAM spike but a deadlock (one
+        process launching a global allgather the others never join).
+        The reference's rank-0 save (ref: src/trainer.py:252-254)
+        generalized to sharded state."""
         logger.info("Config inputs.", config=config)
         enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
@@ -350,6 +365,12 @@ class Trainer:
         self.early_stop_patience = early_stop_patience
         self.save_best = bool(save_best)
         self.decay_exclude_bias_norm = bool(decay_exclude_bias_norm)
+        # Per-host sharded full-state checkpoints (format v3): each process
+        # writes exactly its addressable shards — no host-0 gather, no host
+        # ever holds the full tree.  Requires the checkpoint dir to be
+        # shared storage across hosts (GCS/NFS, the normal pod setup).
+        # None = resolve from the state's shardings once they exist.
+        self._sharded_ckpt = sharded_checkpoint
         self._best_val = math.inf
         self._bad_epochs = 0
         if self.is_parallel:
@@ -676,8 +697,37 @@ class Trainer:
             ema_params=ema_params,
         )
         self._state_shardings = jax.tree.map(lambda x: x.sharding, self.state)
+        if self._sharded_ckpt is None:
+            # Auto: the host-0 v2 gather is a deadlock (not merely a RAM
+            # spike) exactly when some leaf is partitioned across
+            # processes — one process would launch a global allgather the
+            # others never join.  Replicated-only multi-host state keeps
+            # the reference's rank-0 format for compatibility.
+            self._sharded_ckpt = process_count() > 1 and any(
+                not leaf.is_fully_addressable
+                and not getattr(leaf, "is_fully_replicated", False)
+                for leaf in jax.tree.leaves(self.state)
+            )
+            if self._sharded_ckpt:
+                logger.info(
+                    "Partitioned multi-host state: using per-host sharded "
+                    "checkpoints (sharded_checkpoint=True)."
+                )
         train_step = self._make_train_step()
-        self._train_step = jax.jit(train_step, donate_argnums=0)
+        # Pin the output state to the SAME shardings it was born with: the
+        # state's placement is a class invariant (resume/device_put, the
+        # export path, and the v3 checkpoint writer all key off
+        # _state_shardings).  Left unpinned, GSPMD may return some params
+        # leaves data-PARTITIONED under ZeRO-1 (the sharded moments
+        # propagate into the update), which silently turns the
+        # weights-export into a cross-host collective — observed as a
+        # deadlock against the v3 commit barrier.  Pinning restores ZeRO-1
+        # semantics proper: the weight allgather happens INSIDE the
+        # compiled step.
+        step_out_shardings = (self._state_shardings, None, None)
+        self._train_step = jax.jit(
+            train_step, donate_argnums=0, out_shardings=step_out_shardings
+        )
         if self.steps_per_execution > 1:
             # K optimizer steps per dispatch: scan the SAME step function
             # over stacked batches [K, B, ...] — identical update sequence,
@@ -690,7 +740,10 @@ class Trainer:
                 state, (losses, metrics) = jax.lax.scan(body, state, (xs, ys))
                 return state, losses.sum(), metrics.sum()
 
-            self._train_multi_step = jax.jit(multi_step, donate_argnums=0)
+            self._train_multi_step = jax.jit(
+                multi_step, donate_argnums=0,
+                out_shardings=step_out_shardings,
+            )
             # Stacked batches put the step dim first: same data-axis split
             # on dim 1 (and sequence on dim 2 when live).
             spec = self._batch_sharding.spec
@@ -1056,20 +1109,42 @@ class Trainer:
 
                 check_desync(self.state.params)
             # Save on the primary host only (ref: src/trainer.py:252-254).
+            # When params are genuinely PARTITIONED across hosts (TP/FSDP
+            # multi-host), the fetch is a global allgather — a collective —
+            # so every host must join it, or host 0 blocks in a gather the
+            # others never enter (they'd already be in the v3 commit
+            # barrier below).  Replicated params fetch locally and keep
+            # the export primary-only.
+            variables = self._state_variables()
+            export_is_collective = process_count() > 1 and any(
+                not leaf.is_fully_addressable
+                and not getattr(leaf, "is_fully_replicated", False)
+                for leaf in jax.tree.leaves(variables)
+            )
+            host_vars = (
+                ckpt.fetch_to_host(variables)
+                if (is_primary() or export_is_collective) else None
+            )
             if is_primary():
                 logger.info("Saving the model.")
                 from flax import serialization
 
                 # One device fetch + serialization covers both exports
                 # (the best/ copy is the same bytes on improving epochs).
-                data = serialization.to_bytes(
-                    ckpt.fetch_to_host(self._state_variables())
-                )
+                data = serialization.to_bytes(host_vars)
                 ckpt.write_model_bytes(self.model_dir, data)
                 if improved and self.save_best:
                     ckpt.write_model_bytes(
                         os.path.join(self.model_dir, "best"), data
                     )
+            if self._sharded_ckpt:
+                # COLLECTIVE: every process contributes its addressable
+                # shards; no host gathers the full state (format v3).
+                ckpt.save_checkpoint_sharded(
+                    ckpt_dir, self.state, self._partial_history(), epoch,
+                    block=False,
+                )
+            elif is_primary():
                 # Async: the write lands on the background writer thread
                 # while the next epoch trains (jax arrays are immutable, so
                 # the snapshot is consistent); fit-end joins the queue.
@@ -1136,6 +1211,27 @@ class Trainer:
         }
         return h
 
+    def _apply_resume_scalars(self, saved: dict) -> None:
+        """Re-install the host-side training scalars from a restored
+        checkpoint's history dict (no broadcast — the caller guarantees
+        every host sees identical ``saved``, e.g. via shared storage).
+        The v2 multi-host resume path keeps its own inline scalar
+        re-install: there the non-primary hosts have no ``saved`` dict and
+        the values must travel by broadcast instead."""
+        self.train_losses = list(saved.get("train_loss", []))
+        self.val_losses = list(saved.get("val_loss", []))
+        self.train_metrics = list(saved.get("train_metric", []))
+        self.val_metrics = list(saved.get("val_metric", []))
+        self._lr_scale = float(saved.get("lr_scale", 1.0))
+        plateau = saved.get("plateau", {})
+        if self._plateau is not None:
+            self._plateau.best = float(plateau.get("best", np.inf))
+            self._plateau.num_bad_epochs = int(plateau.get("num_bad_epochs", 0))
+            self._plateau.scale = float(plateau.get("scale", 1.0))
+        early = saved.get("early_stop", {})
+        self._best_val = float(early.get("best_val", np.inf))
+        self._bad_epochs = int(early.get("bad_epochs", 0))
+
     def _resume_from_latest(self, ckpt_dir: str) -> int:
         """Restore the latest full checkpoint, multi-host-safely.
 
@@ -1147,19 +1243,51 @@ class Trainer:
         """
         latest = ckpt.latest_checkpoint(ckpt_dir)
         multi_host = process_count() > 1
+        fmt = ckpt.checkpoint_format(latest) if latest is not None else 0
+        epoch_in_name = (
+            int(os.path.basename(latest).split("_")[-1].split(".")[0])
+            if latest is not None else 0
+        )
         if multi_host:
             from jax.experimental import multihost_utils
 
-            # Follow host 0's decision, whatever the local disk says.
-            found = int(
-                multihost_utils.broadcast_one_to_all(
-                    jnp.asarray(1 if latest is not None else 0)
+            # Follow host 0's decision — found, FORMAT and EPOCH — whatever
+            # the local disk says: hosts disagreeing on the listing (NFS
+            # attribute-cache lag) must still take the SAME branch, or one
+            # host enters a broadcast the others never join.
+            found, fmt, epoch_in_name = (
+                int(v)
+                for v in multihost_utils.broadcast_one_to_all(
+                    jnp.asarray([
+                        1 if latest is not None else 0, fmt, epoch_in_name,
+                    ])
                 )
             )
             if not found:
                 return 1
+            if fmt == 3:
+                # v3 lives on shared storage: every host reads the epoch
+                # host 0 picked (its local listing may lag).
+                latest = os.path.join(
+                    ckpt_dir, f"{ckpt.CHECKPOINT_PREFIX}{epoch_in_name}"
+                )
         elif latest is None:
             return 1
+        if fmt == 3:
+            # Sharded (v3): every host reads its own shards from the shared
+            # checkpoint storage and builds its addressable pieces directly
+            # on the target mesh — which may DIFFER from the mesh that
+            # saved (elastic resume).  No state broadcast: nothing here is
+            # host-0-private, and the full tree never materializes.
+            state, saved, done_epoch = ckpt.restore_checkpoint(
+                latest, self.state, self._state_shardings
+            )
+            self.state = state
+            self._apply_resume_scalars(saved)
+            logger.info(
+                f"Resuming from epoch {done_epoch + 1} ({latest}, sharded)."
+            )
+            return done_epoch + 1
         if latest is not None:
             state, saved, done_epoch = ckpt.restore_checkpoint(
                 latest, ckpt.fetch_to_host(self.state)

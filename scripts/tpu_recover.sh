@@ -49,6 +49,12 @@ model_done() {
   grep -hqE "\"model\": \"$1\", \"batch_shape\": [^}]*(\"mfu\": [0-9]|\"backend\": \"tpu\")" \
     "$OUT"/bench_extended.out "$OUT"/one_*.out 2>/dev/null
 }
+# ResNet-50 at larger batch (the MFU ledger, VERDICT r3 #2): rows keyed
+# by their batch_shape so bs=32 cannot vouch for bs=128/256.
+r50_batch_done() {
+  grep -hqE "\"model\": \"resnet50\", \"batch_shape\": \[$1, [^}]*\"backend\": \"tpu\"" \
+    "$OUT"/one_resnet50_b$1.out 2>/dev/null
+}
 golden_done() {
   python - <<'EOF' 2>/dev/null
 import json, sys
@@ -75,6 +81,7 @@ if [ "${1:-}" = "--check" ]; then
   headline_done || exit 1
   loaders_done || exit 1
   for m in resnet50 vit_b16 bert_base gpt2; do model_done "$m" || exit 1; done
+  for b in 128 256; do r50_batch_done "$b" || exit 1; done
   golden_done || exit 1
   flash_done || exit 1
   notebook_done 01 || exit 1
@@ -133,6 +140,16 @@ for m in resnet50 vit_b16 bert_base gpt2; do
   # a swallowed exit 1 instead of the rc-124 timeout that aborts the pass.
   run_stage 600 "$OUT/one_$m.out" python bench.py --one "$m" --assume-up \
     || true
+done
+
+for b in 128 256; do
+  if r50_batch_done "$b"; then
+    echo "== 2b. resnet50 bs=$b: already measured, skipping =="
+    continue
+  fi
+  echo "== 2b. resnet50 bs=$b (MFU ledger) =="
+  run_stage 900 "$OUT/one_resnet50_b$b.out" \
+    python bench.py --one resnet50 --batch_size "$b" --assume-up || true
 done
 
 if golden_done; then

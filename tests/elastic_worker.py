@@ -1,0 +1,53 @@
+"""Worker for tests/test_elastic.py — one phase of an elastic-resume run.
+
+Each invocation is a fresh process so the virtual device count can differ
+between phases: a checkpoint written on an 8-device mesh is resumed on a
+4-device mesh (the TPU-preemption reality: the replacement slice need not
+match the one that died).  Global-batch semantics make the trajectory
+device-count-invariant, so the resumed run must continue the
+uninterrupted reference's losses.
+
+Usage: python elastic_worker.py <ndev> <phase> <workdir> <sharded01>
+  phase: full   — train 4 epochs from scratch
+         first  — train 2 epochs (leaves checkpoints behind)
+         resume — train to epoch 4 with fit(resume=True)
+"""
+
+import os
+import sys
+
+ndev, phase, workdir, sharded = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4] == "1"
+)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={ndev}"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.device_count() == ndev, jax.device_count()
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ml_trainer_tpu import MLModel, Trainer  # noqa: E402
+from ml_trainer_tpu.data import SyntheticCIFAR10  # noqa: E402
+
+datasets = (
+    SyntheticCIFAR10(size=64, seed=0),
+    SyntheticCIFAR10(size=32, seed=1),
+)
+epochs = 2 if phase == "first" else 4
+t = Trainer(
+    MLModel(), datasets=datasets, epochs=epochs, batch_size=16,
+    model_dir=workdir, is_parallel=True, backend="cpu", seed=11, lr=0.01,
+    optimizer="adam", metric=None,
+    shard_opt_state=sharded, sharded_checkpoint=sharded,
+)
+t.fit(resume=(phase == "resume"))
+assert all(np.isfinite(v) for v in t.train_losses)
+print(f"LOSSES {t.train_losses}", flush=True)
+print("WORKER_DONE", flush=True)

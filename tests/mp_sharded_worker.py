@@ -1,0 +1,107 @@
+"""Worker for the 2-process SHARDED-checkpoint test (format v3).
+
+Each rank is one host of a 2-process CPU cluster (4 virtual devices
+each).  Trains with ZeRO-1 + sharded_checkpoint=True, then PROVES the
+no-full-tree property from the on-disk piece tables: this process's
+pieces for the data-sharded optimizer moments cover exactly its
+addressable half of the rows, and the replicated params were written by
+exactly one process (replica-0 dedupe).  Then resumes — every host
+stitches its own shards back from shared storage; no broadcast, no
+gather.
+
+Usage: python mp_sharded_worker.py <coordinator_port> <process_id> <workdir>
+"""
+
+import faulthandler
+import json
+import os
+import sys
+
+# A hung collective is this test's failure mode: dump every thread's stack
+# (and die) well inside the harness timeout so the report shows WHERE.
+faulthandler.dump_traceback_later(150, exit=True)
+
+port, pid, workdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2 and jax.device_count() == 8
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ml_trainer_tpu import MLModel, Trainer  # noqa: E402
+from ml_trainer_tpu.checkpoint import checkpoint as ckpt  # noqa: E402
+from ml_trainer_tpu.data import SyntheticCIFAR10  # noqa: E402
+
+datasets = (
+    SyntheticCIFAR10(size=64, seed=0),
+    SyntheticCIFAR10(size=32, seed=1),
+)
+common = dict(
+    batch_size=16, model_dir=workdir, is_parallel=True, backend="cpu",
+    seed=5, lr=0.001, optimizer="adam", metric=None,
+    shard_opt_state=True, sharded_checkpoint=True,
+)
+
+t = Trainer(MLModel(), datasets=datasets, epochs=2, **common)
+t.fit()
+print(f"LOSSES {t.train_losses}", flush=True)
+
+# --- on-disk proof that this process wrote only its own shards
+ckpt_dir = os.path.join(workdir, "checkpoints")
+latest = ckpt.latest_checkpoint(ckpt_dir)
+assert ckpt.checkpoint_format(latest) == 3
+with open(os.path.join(latest, "manifest.json")) as fp:
+    manifest = json.load(fp)
+with open(os.path.join(latest, f"manifest_p{pid:05d}.json")) as fp:
+    mine = json.load(fp)["pieces"]
+leaves = manifest["leaves"]
+sharded_rows = {}  # leaf id -> rows this process wrote
+for e in mine:
+    meta = leaves[e["leaf"]]
+    dims = meta.get("shape")
+    if dims and tuple(meta["path"])[0] == "opt_state" and len(dims) >= 1:
+        covered = e["stop"][0] - e["start"][0]
+        if covered < dims[0]:  # a genuinely sharded (partial-rows) piece
+            sharded_rows[e["leaf"]] = (
+                sharded_rows.get(e["leaf"], 0) + covered
+            )
+assert sharded_rows, "no sharded optimizer-moment pieces written"
+for leaf_id, rows in sharded_rows.items():
+    total = leaves[leaf_id]["shape"][0]
+    assert rows * 2 == total, (
+        f"leaf {leaf_id}: process {pid} wrote {rows} of {total} rows — "
+        "expected exactly its addressable half"
+    )
+# Replicated params deduped to one writer across the cluster: count both
+# processes' pieces for every params leaf (shared fs: both tables visible).
+tables = ckpt._read_piece_tables(latest)
+for i, meta in enumerate(leaves):
+    if meta.get("shape") is not None and tuple(meta["path"])[0] == "params":
+        assert len(tables[i]) == 1, (meta["path"], len(tables[i]))
+print("SHARD_LAYOUT_OK", flush=True)
+
+# --- resume: every host stitches from shared storage, no broadcast
+t2 = Trainer(MLModel(), datasets=datasets, epochs=3, **common)
+t2.fit(resume=True)
+assert len(t2.train_losses) == 3
+assert t2.train_losses[:2] == t.train_losses, (
+    t2.train_losses, t.train_losses,
+)
+# Params identical across hosts after the sharded restore + 1 epoch.
+fp_local = float(
+    sum(np.abs(np.asarray(x.addressable_data(0))).sum()
+        for x in jax.tree.leaves(t2.state.params))
+)
+print(f"RESUME_OK {t2.train_losses} fp={fp_local:.6f}", flush=True)
+print("WORKER_DONE", flush=True)

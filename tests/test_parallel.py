@@ -408,6 +408,15 @@ def test_hybrid_mesh_dcn_factoring():
     # single slice: nothing to factor.
     ici, dcn = _split_dcn(["data"], [8], ("data",), 1)
     assert (ici, dcn) == ([8], [1])
+    # slice count factors ACROSS dcn axes: 4 slices over data=2 x fsdp=2
+    # (no single axis could absorb 4 — the greedy-gcd generalization).
+    ici, dcn = _split_dcn(
+        ["data", "fsdp", "tensor"], [2, 2, 4], ("data", "fsdp"), 4
+    )
+    assert (ici, dcn) == ([1, 1, 4], [2, 2, 1])
+    # partial absorption per axis: 6 slices over data=4 (takes 2), fsdp=3.
+    ici, dcn = _split_dcn(["data", "fsdp"], [4, 3], ("data", "fsdp"), 6)
+    assert (ici, dcn) == ([2, 1], [2, 3])
     # no dcn axis can absorb the slices -> explicit error.
     import pytest as _pytest
 

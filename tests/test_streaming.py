@@ -1,0 +1,137 @@
+"""Beyond-RAM sharded streaming dataset (VERDICT r3 #3).
+
+``ShardedImageDataset`` memory-maps per-shard ``.npy`` files, so the
+ImageNet-class input pipeline (BASELINE.json configs[1]) never copies the
+dataset into process RAM; both the Python Loader and the C++ NativeLoader
+(segment-table gather, csrc/batch_worker.cpp) must produce EXACTLY the
+batches the in-memory ``ArrayDataset`` path produces — streaming is a
+residency decision, not a semantics change.
+"""
+
+import numpy as np
+import pytest
+
+from ml_trainer_tpu import MLModel, Trainer
+from ml_trainer_tpu.data import (
+    ArrayDataset,
+    Loader,
+    ShardedImageDataset,
+    write_sharded_dataset,
+)
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+
+def _make(root, n=100, seed=0, hw=8, shard=32):
+    """Write a small sharded dataset in ragged chunks; return (dir, x, y)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n, hw, hw, 3), dtype=np.uint8)
+    y = rng.integers(0, 10, size=(n,)).astype(np.int32)
+    # Deliberately ragged chunk sizes: the writer re-chunks to `shard`.
+    cuts = [0, 7, 40, 41, 90, n]
+    chunks = [(x[a:b], y[a:b]) for a, b in zip(cuts, cuts[1:])]
+    write_sharded_dataset(str(root), chunks, samples_per_shard=shard)
+    return str(root), x, y
+
+
+def test_write_and_read_back(tmp_path):
+    root, x, y = _make(tmp_path / "ds")
+    ds = ShardedImageDataset(root)
+    assert len(ds) == 100
+    assert len(ds.shard_maps) == 4  # 32+32+32+4
+    assert all(isinstance(m, np.memmap) for m in ds.shard_maps)
+    # Random single-item and cross-shard batched gathers match the source.
+    for i in (0, 31, 32, 99):
+        xi, yi = ds[i]
+        np.testing.assert_array_equal(xi, x[i])
+        assert yi == y[i]
+    sel = np.asarray([5, 33, 64, 99, 0, 32])  # touches every shard
+    bx, by = ds.batch(sel)
+    np.testing.assert_array_equal(bx, x[sel])
+    np.testing.assert_array_equal(by, y[sel])
+
+
+def test_python_loader_streaming_equals_in_memory(tmp_path):
+    root, x, y = _make(tmp_path / "ds")
+    transform = custom_pre_process_function()
+    # Same transform OBJECT semantics, same seeds -> identical batches.
+    lt_mem = Loader(ArrayDataset(x, y, None), batch_size=16, shuffle=True,
+                    seed=3)
+    lt_str = Loader(ShardedImageDataset(root), batch_size=16, shuffle=True,
+                    seed=3)
+    for (ax, ay), (bx, by) in zip(lt_mem, lt_str):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+    assert transform is not None  # (transform path exercised in fit below)
+
+
+def test_native_loader_streaming_equals_in_memory(tmp_path):
+    pytest.importorskip("ctypes")
+    from ml_trainer_tpu.data.native import NativeLoader, native_available
+
+    if not native_available():
+        pytest.skip("native worker unavailable (no g++)")
+    root, x, y = _make(tmp_path / "ds", n=96, hw=32)
+    mem = NativeLoader(ArrayDataset(x, y, None), batch_size=16, shuffle=True,
+                       seed=3)
+    streaming = NativeLoader(ShardedImageDataset(root), batch_size=16,
+                             shuffle=True, seed=3)
+    mem.set_epoch(1)
+    streaming.set_epoch(1)
+    batches_mem, batches_str = list(mem), list(streaming)
+    assert len(batches_mem) == len(batches_str) == 6
+    for (ax, ay), (bx, by) in zip(batches_mem, batches_str):
+        np.testing.assert_array_equal(ax, bx)  # identical augmentation draws
+        np.testing.assert_array_equal(ay, by)
+
+
+def test_no_full_copy_in_ram(tmp_path):
+    """The dataset object holds only maps + labels: nothing the size of
+    the images lives in process-owned memory."""
+    root, x, y = _make(tmp_path / "ds", n=100)
+    ds = ShardedImageDataset(root)
+    owned = ds.targets.nbytes + ds.shard_starts.nbytes
+    assert owned < x.nbytes / 10
+    # NativeLoader over it must not copy the segments either.
+    from ml_trainer_tpu.data.native import NativeLoader, native_available
+
+    if native_available():
+        nl = NativeLoader(ds, batch_size=10)
+        for seg, m in zip(nl._segments, ds.shard_maps):
+            assert seg.base is m or isinstance(seg, np.memmap), (
+                "segment was copied out of the mapping"
+            )
+
+
+@pytest.mark.slow
+def test_fit_streams_sharded_dataset(tmp_path):
+    """End-to-end: fit() over a sharded on-disk dataset with the reference
+    augmentation — through loader='auto' (native path when available) —
+    matches the identical in-memory run batch for batch."""
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 256, size=(128, 32, 32, 3), dtype=np.uint8)
+    y = rng.integers(0, 10, size=(128,)).astype(np.int32)
+    write_sharded_dataset(str(tmp_path / "train"), [(x, y)],
+                          samples_per_shard=50)
+    xv = rng.integers(0, 256, size=(32, 32, 32, 3), dtype=np.uint8)
+    yv = rng.integers(0, 10, size=(32,)).astype(np.int32)
+    write_sharded_dataset(str(tmp_path / "val"), [(xv, yv)],
+                          samples_per_shard=50)
+    transform = custom_pre_process_function()
+
+    def run(train_ds, val_ds, workdir):
+        t = Trainer(
+            MLModel(), datasets=(train_ds, val_ds), epochs=2, batch_size=16,
+            model_dir=str(workdir), seed=9, lr=0.01, optimizer="adam",
+            metric=None,
+        )
+        t.fit()
+        return t.train_losses
+
+    train_s = ShardedImageDataset(str(tmp_path / "train"), transform)
+    val_s = ShardedImageDataset(str(tmp_path / "val"), transform)
+    losses_stream = run(train_s, val_s, tmp_path / "m1")
+    losses_mem = run(
+        ArrayDataset(x, y, transform), ArrayDataset(xv, yv, transform),
+        tmp_path / "m2",
+    )
+    assert losses_stream == pytest.approx(losses_mem, rel=1e-6)
