@@ -57,22 +57,42 @@ def _probe_backend_subprocess(timeout: float) -> str:
     return ""
 
 
-def _init_devices_with_retry(max_attempts=3, probe_timeout=240.0):
+def _init_devices_with_retry(probe_timeout=None, window_secs=None):
     """Initialize the JAX backend, surviving TPU UNAVAILABLE errors AND
-    init hangs.  Probes in a subprocess first (killable), retries with
-    backoff, and finally falls back to CPU so the driver always gets a
-    parseable JSON line.  Returns (devices, note)."""
-    last = ""
-    for attempt in range(1, max_attempts + 1):
+    init hangs.  Probes in a subprocess (killable) and KEEPS probing with
+    backoff until ``window_secs`` is spent — round-3's driver run showed
+    a wedged tunnel outlasting a fixed 3-attempt budget while recovering
+    minutes later, so the window (default 900s, env
+    ``BENCH_PROBE_WINDOW_SECS``) is what buys the TPU number.  The
+    per-probe budget stays at 240s (env ``BENCH_PROBE_TIMEOUT_SECS``):
+    a slow-but-healthy init that needs 150-240s must be able to SUCCEED
+    within one probe — a shorter per-probe cap would doom every attempt
+    no matter how long the window.  Falls back to CPU only after the
+    window, so the driver always gets a parseable JSON line.  Returns
+    (devices, note)."""
+    import os
+
+    if probe_timeout is None:
+        probe_timeout = float(
+            os.environ.get("BENCH_PROBE_TIMEOUT_SECS", "240")
+        )
+    if window_secs is None:
+        window_secs = float(os.environ.get("BENCH_PROBE_WINDOW_SECS", "900"))
+    deadline = time.time() + window_secs
+    attempt, last = 0, ""
+    while True:
+        attempt += 1
         last = _probe_backend_subprocess(probe_timeout)
         if not last:
             return jax.devices(), ""
         print(
-            f"# backend probe {attempt}/{max_attempts} failed: {last}",
+            f"# backend probe attempt {attempt} failed: {last} "
+            f"({max(0.0, deadline - time.time()):.0f}s of window left)",
             file=sys.stderr,
         )
-        if attempt < max_attempts:
-            time.sleep(min(5.0 * 2 ** (attempt - 1), 30.0))
+        if time.time() >= deadline:
+            break
+        time.sleep(min(10.0 * attempt, 60.0))
     # Fall back to CPU in-process: safe because this process has not touched
     # the default backend yet.
     jax.config.update("jax_platforms", "cpu")
@@ -245,7 +265,12 @@ EXTENDED_CONFIGS = {
                 lambda: dict(num_classes=1000, dtype=jnp.bfloat16)),
     "bert_base": ((32, 128), "tokens",
                   lambda: dict(num_classes=2, dtype=jnp.bfloat16)),
-    "gpt2": ((8, 1024), "lm", lambda: dict(dtype=jnp.bfloat16)),
+    # loss_chunk: bench the trainer's REAL GPT-2 path — the chunked
+    # weight-tied LM loss that never materializes the [B, S, V] logits
+    # (~0.8 GB at bs=8); the full-logits + criterion path is not how the
+    # Trainer runs this model.
+    "gpt2": ((8, 1024), "lm",
+             lambda: dict(dtype=jnp.bfloat16, loss_chunk=128)),
 }
 
 
@@ -309,8 +334,15 @@ def bench_one_model(name: str, batch_size: int | None = None) -> dict:
     )
     has_bs = bool(batch_stats)
 
+    # Models carrying an active loss_chunk compute their own loss inside
+    # the forward (chunked LM head) — same contract the Trainer uses.
+    takes_targets = bool(getattr(model, "loss_chunk", 0))
+
     def step(state, x, y):
         def loss_fn(p):
+            if takes_targets:
+                loss = model.apply({"params": p}, x, train=True, targets=y)
+                return loss, state.batch_stats
             if has_bs:
                 out, mut = model.apply(
                     {"params": p, "batch_stats": state.batch_stats},

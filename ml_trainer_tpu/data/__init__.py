@@ -15,6 +15,7 @@ from ml_trainer_tpu.data.loader import Loader, prefetch_to_device
 from ml_trainer_tpu.data.sampler import ShardedSampler
 from ml_trainer_tpu.data.sharded import (
     ShardedImageDataset,
+    ingest_image_folder,
     write_sharded_dataset,
 )
 from ml_trainer_tpu.data.text import (
@@ -42,6 +43,7 @@ __all__ = [
     "prefetch_to_device",
     "ShardedSampler",
     "ShardedImageDataset",
+    "ingest_image_folder",
     "write_sharded_dataset",
     "PackedLMDataset",
     "TokenizedDataset",

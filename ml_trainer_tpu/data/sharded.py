@@ -133,6 +133,14 @@ class ShardedImageDataset(Dataset):
         return self.total
 
     def __getitem__(self, idx: int):
+        # Python indexing semantics match ArrayDataset — streaming is a
+        # residency decision, not a semantics change.
+        if idx < 0:
+            idx += self.total
+        if not 0 <= idx < self.total:
+            raise IndexError(
+                f"index {idx} out of range for dataset of {self.total}"
+            )
         s = int(np.searchsorted(self.shard_starts, idx, "right") - 1)
         return (
             np.asarray(self.shard_maps[s][idx - self.shard_starts[s]]),
@@ -149,3 +157,60 @@ class ShardedImageDataset(Dataset):
             rows = shard_of == s
             out[rows] = self.shard_maps[s][indices[rows] - self.shard_starts[s]]
         return out, self.targets[indices]
+
+
+def ingest_image_folder(
+    src: str,
+    dst: str,
+    size: Tuple[int, int] = (224, 224),
+    samples_per_shard: int = 4096,
+    extensions: Tuple[str, ...] = (".jpg", ".jpeg", ".png", ".bmp"),
+    decode_batch: int = 256,
+) -> str:
+    """Decode a torchvision-``ImageFolder``-layout directory
+    (``src/<class_name>/*.jpg``, classes labeled by sorted name) into the
+    sharded on-disk format — the ImageNet ingestion path.
+
+    Decoding streams: ``decode_batch`` images are decoded (PIL), resized
+    to ``size`` and handed to the sharded writer at a time, so peak RAM
+    is one shard regardless of dataset size.  Returns ``dst`` (open with
+    ``ShardedImageDataset``)."""
+    from PIL import Image
+
+    classes = sorted(
+        d for d in os.listdir(src)
+        if os.path.isdir(os.path.join(src, d))
+    )
+    if not classes:
+        raise ValueError(f"no class directories under {src!r}")
+    files = [
+        (os.path.join(src, c, f), label)
+        for label, c in enumerate(classes)
+        for f in sorted(os.listdir(os.path.join(src, c)))
+        if f.lower().endswith(extensions)
+    ]
+    if not files:
+        raise ValueError(f"no image files under {src!r}")
+
+    def chunks():
+        for lo in range(0, len(files), decode_batch):
+            part = files[lo : lo + decode_batch]
+            xs = np.empty((len(part),) + size + (3,), np.uint8)
+            ys = np.empty((len(part),), np.int32)
+            for i, (path, label) in enumerate(part):
+                with Image.open(path) as im:
+                    xs[i] = np.asarray(
+                        im.convert("RGB").resize(
+                            (size[1], size[0]), Image.BILINEAR
+                        )
+                    )
+                ys[i] = label
+            yield xs, ys
+
+    write_sharded_dataset(dst, chunks(), samples_per_shard=samples_per_shard)
+    with open(os.path.join(dst, INDEX_FILE)) as fp:
+        index = json.load(fp)
+    index["classes"] = classes
+    with open(os.path.join(dst, INDEX_FILE), "w") as fp:
+        json.dump(index, fp)
+    return dst

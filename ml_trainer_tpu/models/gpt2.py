@@ -178,6 +178,8 @@ class GPT2Pipelined(nn.Module):
     dtype: jnp.dtype = jnp.float32
     mesh: object = None  # jax Mesh with a live 'stage' axis -> pipelined
     n_microbatches: int = 0  # 0 -> one microbatch per stage
+    remat: bool = False  # recompute stage bodies in backward (O(1) ticks
+    # of activation memory instead of O(S+M-1); math unchanged)
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
@@ -213,10 +215,12 @@ class GPT2Pipelined(nn.Module):
             x = pipeline_apply(
                 stage_fn, blocks, x, self.mesh,
                 n_microbatches=self.n_microbatches or None,
+                remat=self.remat,
             )
         else:
+            body = jax.checkpoint(stage_fn) if self.remat else stage_fn
             x, _ = jax.lax.scan(
-                lambda carry, p: (stage_fn(p, carry), None), x, blocks
+                lambda carry, p: (body(p, carry), None), x, blocks
             )
         return _tied_head(self, x, tok_embed)
 

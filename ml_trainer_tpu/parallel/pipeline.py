@@ -32,7 +32,7 @@ def stack_stage_params(per_stage_params: list) -> Any:
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
 
 
-def _pipeline_local(params, x, *, stage_fn, axis_name, n_micro):
+def _pipeline_local(params, x, *, stage_fn, axis_name, n_micro, remat):
     """Per-device body under shard_map.
 
     params: this device's stage params (leading stage dim of size 1).
@@ -43,6 +43,14 @@ def _pipeline_local(params, x, *, stage_fn, axis_name, n_micro):
     params = jax.tree.map(lambda p: p[0], params)  # drop the stage dim
     mb_shape = x.shape[1:]
     fwd_perm = [(s, s + 1) for s in range(n_stages - 1)]
+    if remat:
+        # Differentiating through the scan stores every tick's stage
+        # activations for the backward — O(S + M - 1) ticks of them per
+        # device.  Checkpointing the stage body keeps only the scan carry
+        # and recomputes the body during the reverse pass: activation
+        # memory drops to O(1) ticks for one extra forward of compute,
+        # the standard pipeline-training trade.
+        stage_fn = jax.checkpoint(stage_fn)
 
     def tick(carry, t):
         prev_out, outputs = carry
@@ -93,6 +101,7 @@ def pipeline_apply(
     axis_name: str = "stage",
     n_microbatches: int = None,
     batch_axis: str = "data",
+    remat: bool = False,
 ) -> jax.Array:
     """Run ``x`` through ``n_stages`` sequential stages, pipelined.
 
@@ -102,7 +111,9 @@ def pipeline_apply(
     (see ``stack_stage_params``).  ``x``: [batch, ...] — split into
     ``n_microbatches`` equal microbatches (default: one per stage).
     Semantically equivalent to folding ``stage_fn`` serially; the pipeline
-    only changes WHERE each stage runs and WHEN.
+    only changes WHERE each stage runs and WHEN.  ``remat=True``
+    recomputes stage bodies in the backward pass instead of storing every
+    tick's activations (math unchanged — see ``_pipeline_local``).
 
     When the mesh also has a live ``batch_axis`` (dp × pp), each
     microbatch's batch dim shards over it — the data-parallel replicas
@@ -126,6 +137,7 @@ def pipeline_apply(
             stage_fn=stage_fn,
             axis_name=axis_name,
             n_micro=n_micro,
+            remat=remat,
         ),
         mesh=mesh,
         in_specs=(

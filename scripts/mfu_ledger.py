@@ -1,0 +1,167 @@
+"""ResNet-50 MFU ledger — where the time goes, measured on the chip.
+
+The 19.3% MFU headline row (BASELINE.md) was taken at bs=32 with no
+breakdown.  This script measures the full train step at bs=32/128/256
+and writes a roofline ledger per batch size:
+
+* achieved FLOP/s vs the chip's bf16 peak (MFU),
+* achieved HBM bytes/s vs the chip's peak bandwidth,
+* the flops/byte arithmetic intensity of the compiled program,
+
+which together say WHETHER each configuration is MXU-bound or HBM-bound
+and how much the MXU fills as the batch grows — the evidence VERDICT r3
+item 2 asks for.  Writes docs/resnet50_mfu_ledger.json and prints one
+line per row.
+
+    python scripts/mfu_ledger.py [--model resnet50] [--batches 32,128,256]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# Published per-chip HBM bandwidth by generation; the FLOPs peak comes
+# from bench.py's _chip_peak_flops so the ledger's MFU denominator can
+# never disagree with the BASELINE rows by hardware generation.
+HBM_PEAKS = {
+    "v6e": 1640e9, "v6": 1640e9,
+    "v5p": 2765e9,
+    "v5e": 819e9, "v5 lite": 819e9, "v5lite": 819e9,
+    "v4": 1228e9,
+}
+
+
+def chip_peaks():
+    from bench import _chip_peak_flops
+
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
+    bw = next(
+        (v for k, v in HBM_PEAKS.items() if k in gen or k in kind), 819e9
+    )
+    return _chip_peak_flops(), bw
+
+
+def measure(model_name: str, batch: int) -> dict:
+    import optax
+
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.ops import get_criterion, get_optimizer
+    from ml_trainer_tpu.train_state import TrainState
+    from ml_trainer_tpu.utils.profiler import force
+
+    model = get_model(model_name, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(batch, 224, 224, 3)), jnp.bfloat16)
+    y = jnp.asarray(rng.integers(0, 10, batch), jnp.int32)
+    jax.block_until_ready((x, y))
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, x, train=False
+    )
+    params = variables["params"]
+    tx = get_optimizer("adamw", 1e-4)
+    criterion = get_criterion("cross_entropy")
+    state = TrainState(
+        step=jnp.zeros((), jnp.int32), params=params,
+        opt_state=jax.jit(tx.init)(params),
+        batch_stats=variables.get("batch_stats", {}),
+        rng=jax.random.PRNGKey(1),
+    )
+
+    def step(state, x, y):
+        def loss_fn(p):
+            out, mut = model.apply(
+                {"params": p, "batch_stats": state.batch_stats},
+                x, train=True, mutable=["batch_stats"],
+            )
+            return criterion(out, y), mut["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params
+        )
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        return state.replace(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            opt_state=opt_state,
+            batch_stats=new_bs,
+        ), loss
+
+    compiled = jax.jit(step, donate_argnums=0).lower(state, x, y).compile()
+    cost = compiled.cost_analysis()
+    cost = cost[0] if isinstance(cost, (list, tuple)) else (cost or {})
+    flops = float(cost.get("flops", 0.0))
+    bytes_accessed = float(cost.get("bytes accessed", 0.0))
+
+    # Timing: chain iterations so in-order completion is provable (the
+    # platform's block_until_ready can return early — utils/profiler.py).
+    iters = 20
+    for _ in range(3):
+        state, loss = compiled(state, x, y)
+    force(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = compiled(state, x, y)
+    force(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    peak_flops, peak_bw = chip_peaks()
+    achieved_flops = flops / dt if flops else None
+    achieved_bw = bytes_accessed / dt if bytes_accessed else None
+    row = {
+        "model": model_name,
+        "batch": batch,
+        "step_ms": round(dt * 1e3, 3),
+        "samples_per_sec": round(batch / dt, 1),
+        "flops_per_step": flops,
+        "bytes_per_step": bytes_accessed,
+        "arith_intensity_flops_per_byte": (
+            round(flops / bytes_accessed, 1) if bytes_accessed else None
+        ),
+        "mfu": round(achieved_flops / peak_flops, 4) if achieved_flops else None,
+        "hbm_utilization": (
+            round(achieved_bw / peak_bw, 4) if achieved_bw else None
+        ),
+        # The machine balance of the chip: programs below this intensity
+        # cannot reach peak FLOP/s no matter how well they schedule.
+        "machine_balance_flops_per_byte": round(peak_flops / peak_bw, 1),
+        "backend": jax.default_backend(),
+    }
+    # The verdict: which wall is closer.
+    if row["mfu"] is not None and row["hbm_utilization"] is not None:
+        row["bound"] = (
+            "hbm" if row["hbm_utilization"] > row["mfu"] else "mxu"
+        )
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="resnet50")
+    ap.add_argument("--batches", default="32,128,256")
+    args = ap.parse_args()
+    assert jax.default_backend() == "tpu", (
+        f"ledger needs the chip, got {jax.default_backend()}"
+    )
+    rows = []
+    for b in (int(s) for s in args.batches.split(",")):
+        row = measure(args.model, b)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    out = os.path.join(ROOT, "docs", f"{args.model}_mfu_ledger.json")
+    with open(out, "w") as fp:
+        json.dump({"device": str(jax.devices()[0]), "rows": rows}, fp, indent=1)
+    print(f"-> {out}")
+
+
+if __name__ == "__main__":
+    main()

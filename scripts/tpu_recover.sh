@@ -55,6 +55,15 @@ r50_batch_done() {
   grep -hqE "\"model\": \"resnet50\", \"batch_shape\": \[$1, [^}]*\"backend\": \"tpu\"" \
     "$OUT"/one_resnet50_b$1.out 2>/dev/null
 }
+ledger_done() {
+  python - <<'EOF' 2>/dev/null
+import json, sys
+rec = json.load(open("docs/resnet50_mfu_ledger.json"))
+rows = rec.get("rows", [])
+ok = {r.get("batch") for r in rows if r.get("backend") == "tpu"}
+sys.exit(0 if {32, 128, 256} <= ok else 1)
+EOF
+}
 golden_done() {
   python - <<'EOF' 2>/dev/null
 import json, sys
@@ -82,6 +91,7 @@ if [ "${1:-}" = "--check" ]; then
   loaders_done || exit 1
   for m in resnet50 vit_b16 bert_base gpt2; do model_done "$m" || exit 1; done
   for b in 128 256; do r50_batch_done "$b" || exit 1; done
+  ledger_done || exit 1
   golden_done || exit 1
   flash_done || exit 1
   notebook_done 01 || exit 1
@@ -151,6 +161,13 @@ for b in 128 256; do
   run_stage 900 "$OUT/one_resnet50_b$b.out" \
     python bench.py --one resnet50 --batch_size "$b" --assume-up || true
 done
+
+if ledger_done; then
+  echo "== 2c. MFU ledger: already recorded, skipping =="
+else
+  echo "== 2c. resnet50 MFU roofline ledger =="
+  run_stage 1500 "$OUT/ledger.out" python scripts/mfu_ledger.py || true
+fi
 
 if golden_done; then
   echo "== 3. golden: TPU record already committed, skipping =="

@@ -12,6 +12,10 @@ matplotlib.use("Agg")
 import numpy as np
 import pytest
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
@@ -103,3 +107,15 @@ def test_example_06_long_context(monkeypatch, tmp_path):
         "REMAT": "1", "REMAT_POLICY": "dots", "LOSS_CHUNK": "16",
     })
     assert (tmp_path / "lc" / "history.pkl").exists()
+
+
+def test_example_07_streaming_and_elastic(monkeypatch, tmp_path):
+    run_example("07_streaming_and_elastic.py", monkeypatch, tmp_path, {
+        "MODEL_DIR": str(tmp_path / "sr"), "EPOCHS": "1",
+    })
+    assert (tmp_path / "sr" / "checkpoints").is_dir()
+    # Resume on the same mesh (the elastic cross-device-count variant is
+    # tests/test_elastic.py): a second invocation continues cleanly.
+    run_example("07_streaming_and_elastic.py", monkeypatch, tmp_path, {
+        "MODEL_DIR": str(tmp_path / "sr"), "EPOCHS": "2", "RESUME": "1",
+    })

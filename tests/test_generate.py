@@ -14,6 +14,10 @@ import pytest
 from ml_trainer_tpu.generate import generate
 from ml_trainer_tpu.models import get_model
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 
 def _naive_greedy(model, variables, ids, n):
     seq = ids

@@ -18,6 +18,10 @@ import os
 
 import pytest
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 _GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
 # The TPU capture is the canonical record; until a tunnel window produces
 # it, the CPU capture (same config/seeds, backend noted inside) keeps the

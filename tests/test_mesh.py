@@ -1,0 +1,72 @@
+"""Mesh-construction unit layer (fast lane): axis ordering, hybrid
+DCNxICI slice factoring.  The training-trajectory integration tests for
+the same module live in test_parallel.py (slow lane)."""
+
+import jax
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.parallel import create_mesh, mesh_shape_for
+
+
+def test_mesh_shape_for():
+    assert mesh_shape_for(8) == {
+        "data": 8, "fsdp": 1, "stage": 1, "expert": 1, "sequence": 1,
+        "tensor": 1,
+    }
+    assert mesh_shape_for(8, tensor=2)["data"] == 4
+    with pytest.raises(ValueError):
+        mesh_shape_for(8, tensor=3)
+
+
+def test_create_mesh_axes():
+    mesh = create_mesh({"data": 4, "tensor": 2})
+    assert mesh.axis_names == ("data", "tensor")
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_hybrid_mesh_falls_back_on_single_slice():
+    """No slice_index on the CPU mesh -> create_hybrid_mesh must produce
+    the same mesh create_mesh would, so multi-slice code rehearses here."""
+    from ml_trainer_tpu.parallel.mesh import create_hybrid_mesh, create_mesh
+
+    shape = {"data": 4, "tensor": 2}
+    hybrid = create_hybrid_mesh(shape)
+    plain = create_mesh(shape)
+    assert hybrid.axis_names == plain.axis_names
+    assert hybrid.shape == plain.shape
+    assert [d.id for d in hybrid.devices.flat] == [
+        d.id for d in plain.devices.flat
+    ]
+
+
+def test_hybrid_mesh_dcn_factoring():
+    """The slice count factors out of the first divisible dcn axis; the
+    elementwise ici*dcn product always reproduces the requested dims."""
+    from ml_trainer_tpu.parallel.mesh import _split_dcn
+
+    # data spans 2 slices and keeps a 4-way ICI remainder.
+    ici, dcn = _split_dcn(["data", "tensor"], [8, 4], ("data",), 2)
+    assert (ici, dcn) == ([4, 4], [2, 1])
+    # data == slice count exactly: all of it goes to DCN.
+    ici, dcn = _split_dcn(["data", "tensor"], [4, 2], ("data",), 4)
+    assert (ici, dcn) == ([1, 2], [4, 1])
+    # single slice: nothing to factor.
+    ici, dcn = _split_dcn(["data"], [8], ("data",), 1)
+    assert (ici, dcn) == ([8], [1])
+    # slice count factors ACROSS dcn axes: 4 slices over data=2 x fsdp=2
+    # (no single axis could absorb 4 — the greedy-gcd generalization).
+    ici, dcn = _split_dcn(
+        ["data", "fsdp", "tensor"], [2, 2, 4], ("data", "fsdp"), 4
+    )
+    assert (ici, dcn) == ([1, 1, 4], [2, 2, 1])
+    # partial absorption per axis: 6 slices over data=4 (takes 2), fsdp=3.
+    ici, dcn = _split_dcn(["data", "fsdp"], [4, 3], ("data", "fsdp"), 6)
+    assert (ici, dcn) == ([2, 1], [2, 3])
+    # no dcn axis can absorb the slices -> explicit error.
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="cannot span"):
+        _split_dcn(["tensor"], [8], ("data",), 2)
+    with _pytest.raises(ValueError, match="cannot span"):
+        _split_dcn(["data"], [3], ("data",), 2)  # not divisible

@@ -8,6 +8,10 @@ import pytest
 from ml_trainer_tpu.models import get_model, MLModel
 from ml_trainer_tpu.models.registry import available_models
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 
 def init_and_apply(model, x, train=False):
     rngs = {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)}

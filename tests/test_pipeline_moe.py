@@ -18,6 +18,10 @@ from ml_trainer_tpu.parallel import (
     stack_stage_params,
 )
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 
 # ----------------------------------------------------------------- pipeline
 def _stage_fn(params, x):
@@ -74,6 +78,30 @@ def test_pipeline_under_jit_and_grad():
     g_serial_stacked = stack_stage_params(g_serial)
     for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_serial_stacked)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_pipeline_remat_matches_stored_activations():
+    """remat=True recomputes stage bodies in the backward — identical
+    values AND gradients to the stored-activation schedule."""
+    mesh = create_mesh({"stage": 4}, devices=jax.devices()[:4])
+    stages = _make_stages(4, 8, seed=5)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(7).normal(size=(8, 8)), jnp.float32)
+
+    def loss(p, remat):
+        return jnp.sum(
+            pipeline_apply(_stage_fn, p, x, mesh, remat=remat) ** 2
+        )
+
+    v_plain, g_plain = jax.jit(
+        jax.value_and_grad(lambda p: loss(p, False))
+    )(stacked)
+    v_remat, g_remat = jax.jit(
+        jax.value_and_grad(lambda p: loss(p, True))
+    )(stacked)
+    np.testing.assert_allclose(v_plain, v_remat, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g_plain), jax.tree.leaves(g_remat)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
 
 
 def test_pipeline_rejects_indivisible_batch():

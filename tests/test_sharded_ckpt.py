@@ -127,6 +127,7 @@ def test_v3_uncommitted_checkpoint_invisible(tmp_path):
     assert latest is not None and latest.endswith("checkpoint_1")
 
 
+@pytest.mark.slow
 def test_trainer_sharded_checkpoint_trajectory(tmp_path):
     """Trainer(sharded_checkpoint=True) + ZeRO-1: resume continues the
     exact trajectory of an uninterrupted run (the v2-parity guarantee,

@@ -1,0 +1,35 @@
+"""Fast-lane training smoke: ONE tiny end-to-end fit + resume + test.
+
+The `-m "not slow"` subset is the CI gate that must finish in minutes;
+the trajectory-equality and multi-process proofs live in the slow lane.
+This file keeps the fast lane honest about the core loop: a fit() that
+trains, checkpoints, resumes, and serves test() must work before any
+deeper property can.
+"""
+
+import numpy as np
+import pytest
+
+from ml_trainer_tpu import MLModel, Trainer
+from ml_trainer_tpu.data import Loader, SyntheticCIFAR10
+
+
+def test_fit_resume_and_test_smoke(tmp_path):
+    ds = (SyntheticCIFAR10(size=32, seed=0), SyntheticCIFAR10(size=16, seed=1))
+    common = dict(
+        datasets=ds, batch_size=16, model_dir=str(tmp_path),
+        metric="accuracy", optimizer="adam", lr=0.001,
+    )
+    t = Trainer(MLModel(), epochs=1, **common)
+    t.fit()
+    assert len(t.train_losses) == 1 and np.isfinite(t.train_losses[0])
+
+    resumed = Trainer(MLModel(), epochs=2, **common)
+    resumed.fit(resume=True)
+    assert resumed.train_losses[0] == pytest.approx(t.train_losses[0])
+    assert len(resumed.train_losses) == 2
+
+    loss, acc = resumed.test(
+        None, Loader(SyntheticCIFAR10(size=16, seed=2), batch_size=16)
+    )
+    assert np.isfinite(loss) and 0.0 <= acc <= 1.0

@@ -48,6 +48,14 @@ def test_write_and_read_back(tmp_path):
     bx, by = ds.batch(sel)
     np.testing.assert_array_equal(bx, x[sel])
     np.testing.assert_array_equal(by, y[sel])
+    # Python indexing semantics match ArrayDataset.
+    xi, yi = ds[-1]
+    np.testing.assert_array_equal(xi, x[-1])
+    assert yi == y[-1]
+    with pytest.raises(IndexError):
+        ds[100]
+    with pytest.raises(IndexError):
+        ds[-101]
 
 
 def test_python_loader_streaming_equals_in_memory(tmp_path):
@@ -100,6 +108,44 @@ def test_no_full_copy_in_ram(tmp_path):
             assert seg.base is m or isinstance(seg, np.memmap), (
                 "segment was copied out of the mapping"
             )
+
+
+def test_ingest_image_folder(tmp_path):
+    """ImageFolder-layout JPEG/PNG trees decode + resize into the sharded
+    format with sorted-name class labels (the ImageNet ingestion path)."""
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from ml_trainer_tpu.data.sharded import ingest_image_folder
+
+    rng = np.random.default_rng(0)
+    src = tmp_path / "raw"
+    for cls in ("dog", "cat"):  # sorted -> cat=0, dog=1
+        (src / cls).mkdir(parents=True)
+    for i in range(5):
+        Image.fromarray(
+            rng.integers(0, 256, (37, 53, 3), dtype=np.uint8)
+        ).save(src / "dog" / f"d{i}.png")
+    for i in range(3):
+        Image.fromarray(
+            rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+        ).save(src / "cat" / f"c{i}.jpg")
+    dst = ingest_image_folder(
+        str(src), str(tmp_path / "sharded"), size=(16, 16),
+        samples_per_shard=4, decode_batch=3,
+    )
+    ds = ShardedImageDataset(dst)
+    assert len(ds) == 8 and ds.shape == (16, 16, 3)
+    assert len(ds.shard_maps) == 2  # 4 + 4
+    # cat files come first (sorted class names), labeled 0.
+    np.testing.assert_array_equal(ds.targets[:3], 0)
+    np.testing.assert_array_equal(ds.targets[3:], 1)
+    import json as _json
+    import os as _os
+
+    index = _json.load(open(_os.path.join(dst, "index.json")))
+    assert index["classes"] == ["cat", "dog"]
+    assert PIL is not None
 
 
 @pytest.mark.slow

@@ -14,6 +14,10 @@ from ml_trainer_tpu.data import (
 )
 from ml_trainer_tpu.models import get_model
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 
 # ------------------------------------------------------------------- text
 def test_tokenize_texts_offline_fallback():

@@ -14,6 +14,10 @@ from ml_trainer_tpu.data import SyntheticCIFAR10
 from ml_trainer_tpu.models import get_model
 from ml_trainer_tpu.utils.functions import custom_pre_process_function
 
+# Integration layer: multi-epoch fits / trajectory equality / compiled
+# programs — the CI fast lane is `-m 'not slow'` (see pyproject.toml).
+pytestmark = pytest.mark.slow
+
 
 def make_datasets(n_train=64, n_val=32, transform=False):
     t = custom_pre_process_function() if transform else None
