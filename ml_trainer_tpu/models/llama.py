@@ -1,0 +1,266 @@
+"""Llama-family decoder: RMSNorm + RoPE + grouped-query attention + SwiGLU.
+
+No analog in the reference (its only model is a 62K-param CNN,
+ref: src/model.py) and beyond the north-star zoo — this is the modern
+LM architecture the framework must also serve to be a complete
+training stack.  Everything rides the existing TPU-first machinery:
+attention flows through ``ops.attention`` (flash kernel on causal
+tile-aligned shapes), the chunked LM loss keeps the [B, S, V] logits
+unmaterialized, per-block remat reuses the shared policies, and
+KV-cached generation works through ``generate()`` unchanged — with the
+GQA twist that the cache stores the UN-repeated ``num_kv_heads`` K/V
+(the whole point of GQA: an H/Hkv-times smaller inference cache).
+
+Architectural choices match the published Llama arrangement: pre-RMSNorm
+blocks, rotary embeddings applied to q/k per head (rotate-half
+convention), no biases anywhere, SwiGLU feed-forward, untied LM head.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ml_trainer_tpu.models.registry import register_model
+from ml_trainer_tpu.ops.attention import attention
+
+
+def apply_rope(x: jax.Array, positions: jax.Array,
+               theta: float = 10000.0) -> jax.Array:
+    """Rotary position embedding, rotate-half convention.
+
+    x: [B, H, S, D] (D even); positions: [S] absolute token positions.
+    Angles are computed in f32 regardless of activation dtype (bf16
+    angles at position ~1000 lose the low bits that distinguish
+    neighboring positions), result cast back."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [S, half]
+    cos = jnp.cos(angles)[None, None]
+    sin = jnp.sin(angles)[None, None]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class GQAttention(nn.Module):
+    """Grouped-query attention: ``num_heads`` query heads share
+    ``num_kv_heads`` key/value heads (H % Hkv == 0).  K/V are repeated
+    up to H only at the attention compute; projections, the decode
+    cache, and (in decode) HBM traffic all stay at the Hkv width."""
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+    mesh: Optional[object] = None
+    decode: bool = False
+    decode_max_len: int = 0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.num_heads % self.num_kv_heads:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by "
+                f"num_kv_heads {self.num_kv_heads}"
+            )
+        b, s, _ = x.shape
+        h, hk, d = self.num_heads, self.num_kv_heads, self.head_dim
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=False, dtype=self.dtype, name=name
+        )
+        q = dense(h * d, "q")(x).reshape(b, s, h, d).transpose(0, 2, 1, 3)
+        k = dense(hk * d, "k")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
+        v = dense(hk * d, "v")(x).reshape(b, s, hk, d).transpose(0, 2, 1, 3)
+
+        if self.decode:
+            out = self._decode_step(q, k, v)
+        else:
+            positions = jnp.arange(s)
+            q = apply_rope(q, positions, self.rope_theta)
+            k = apply_rope(k, positions, self.rope_theta)
+            out = attention(
+                q, jnp.repeat(k, h // hk, axis=1),
+                jnp.repeat(v, h // hk, axis=1),
+                causal=True, implementation=self.attention_impl,
+                mesh=self.mesh,
+            )
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        return dense(x.shape[-1], "proj")(out)
+
+    def _decode_step(self, q, k, v):
+        """KV-cached decode (see layers.MultiHeadAttention._decode_step —
+        same contract: S>1 is the empty-cache prefill, S==1 incremental).
+        RoPE is applied BEFORE caching K, so cached keys already carry
+        their absolute positions; the cache holds Hkv heads."""
+        b, h, s, d = q.shape
+        hk = self.num_kv_heads
+        L = self.decode_max_len
+        if L <= 0:
+            raise ValueError("decode=True needs decode_max_len > 0")
+        cached_k = self.variable(
+            "cache", "cached_key", lambda: jnp.zeros((b, hk, L, d), self.dtype)
+        )
+        cached_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((b, hk, L, d), self.dtype),
+        )
+        idx_var = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = idx_var.value
+        positions = idx + jnp.arange(s)
+        q = apply_rope(q, positions, self.rope_theta)
+        k = apply_rope(k, positions, self.rope_theta)
+        cached_k.value = jax.lax.dynamic_update_slice(
+            cached_k.value, k.astype(self.dtype), (0, 0, idx, 0)
+        )
+        cached_v.value = jax.lax.dynamic_update_slice(
+            cached_v.value, v.astype(self.dtype), (0, 0, idx, 0)
+        )
+        idx_var.value = idx + s
+        rep = h // hk
+        if s > 1:
+            # Prefill over the prompt itself (empty-cache contract; see
+            # layers.py for the NaN poisoning rationale).
+            q = jnp.where(idx == 0, q, jnp.nan)
+            return attention(
+                q, jnp.repeat(k, rep, axis=1), jnp.repeat(v, rep, axis=1),
+                causal=True, implementation="auto",
+            )
+        valid = (jnp.arange(L) <= idx)[None, None, None, :]
+        return attention(
+            q,
+            jnp.repeat(cached_k.value, rep, axis=1),
+            jnp.repeat(cached_v.value, rep, axis=1),
+            causal=False, mask=valid, implementation="xla",
+        )
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    hidden_dim: int
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+    mesh: Optional[object] = None
+    decode: bool = False
+    decode_max_len: int = 0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        attn = GQAttention(
+            self.num_heads, self.num_kv_heads, self.head_dim,
+            rope_theta=self.rope_theta, dtype=self.dtype,
+            attention_impl=self.attention_impl, mesh=self.mesh,
+            decode=self.decode, decode_max_len=self.decode_max_len,
+            name="attn",
+        )
+        x = x + attn(nn.RMSNorm(dtype=self.dtype, name="ln1")(x), train=train)
+        y = nn.RMSNorm(dtype=self.dtype, name="ln2")(x)
+        # SwiGLU: down(silu(gate(y)) * up(y)) — the Llama feed-forward.
+        dense = lambda n, name: nn.Dense(  # noqa: E731
+            n, use_bias=False, dtype=self.dtype, name=name
+        )
+        y = dense(x.shape[-1], "down")(
+            nn.silu(dense(self.hidden_dim, "gate")(y))
+            * dense(self.hidden_dim, "up")(y)
+        )
+        return x + y
+
+
+class LlamaLM(nn.Module):
+    """Causal Llama-style LM.  ``targets`` (with ``loss_chunk`` > 0)
+    switches to the model-computed chunked loss — the untied lm_head
+    kernel plays the embedding-matrix role, so the [B, S, V] logits are
+    never materialized (ops/losses.chunked_lm_cross_entropy)."""
+
+    vocab_size: int = 32000
+    max_len: int = 2048
+    embed_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    num_kv_heads: int = 4
+    hidden_dim: int = 0  # 0 -> the Llama default ~8/3 * embed, rounded
+    rope_theta: float = 10000.0
+    dtype: jnp.dtype = jnp.float32
+    attention_impl: str = "auto"
+    mesh: Optional[object] = None
+    remat: bool = False
+    remat_policy: str = "none"
+    loss_chunk: int = 0
+    decode: bool = False
+
+    @nn.compact
+    def __call__(self, input_ids, train: bool = False, targets=None):
+        from ml_trainer_tpu.models.layers import remat_policy
+
+        hidden = self.hidden_dim or int(
+            ((8 * self.embed_dim // 3) + 127) // 128 * 128
+        )
+        head_dim = self.embed_dim // self.num_heads
+        x = nn.Embed(
+            self.vocab_size, self.embed_dim, dtype=self.dtype,
+            name="tok_embed",
+        )(input_ids)
+        Block = LlamaBlock
+        if self.remat:
+            Block = nn.remat(
+                LlamaBlock, static_argnums=(2,),
+                policy=remat_policy(self.remat_policy),
+            )
+        for i in range(self.depth):
+            x = Block(
+                self.num_heads, self.num_kv_heads, head_dim, hidden,
+                rope_theta=self.rope_theta, dtype=self.dtype,
+                attention_impl=self.attention_impl, mesh=self.mesh,
+                decode=self.decode,
+                decode_max_len=self.max_len if self.decode else 0,
+                name=f"block{i}",
+            )(x, train)
+        x = nn.RMSNorm(dtype=self.dtype, name="ln_final")(x)
+        lm_head = self.param(
+            "lm_head",
+            nn.initializers.normal(0.02),
+            (self.embed_dim, self.vocab_size),
+            jnp.float32,
+        )
+        if targets is not None:
+            if not self.loss_chunk:
+                raise ValueError(
+                    "targets requires loss_chunk > 0 (a divisor of the "
+                    "sequence length)"
+                )
+            from ml_trainer_tpu.ops.losses import chunked_lm_cross_entropy
+
+            return chunked_lm_cross_entropy(
+                x, lm_head.T, targets, self.loss_chunk
+            )
+        return x.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+
+
+@register_model("llama")
+def llama(**kw) -> LlamaLM:
+    """~160M Llama-style config (GQA 12q/4kv, SwiGLU, RoPE)."""
+    return LlamaLM(**kw)
+
+
+@register_model("llama_tiny")
+def llama_tiny(**kw) -> LlamaLM:
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("max_len", 128)
+    kw.setdefault("embed_dim", 64)
+    kw.setdefault("depth", 2)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("num_kv_heads", 2)
+    return LlamaLM(**kw)

@@ -14,7 +14,7 @@ import flax.linen as nn
 
 MODELS: Dict[str, Callable[..., nn.Module]] = {}
 
-_FAMILY_MODULES = ("mlmodel", "resnet", "vit", "bert", "gpt2")
+_FAMILY_MODULES = ("mlmodel", "resnet", "vit", "bert", "gpt2", "llama")
 
 
 def register_model(name: str):

@@ -81,8 +81,24 @@ def _lion(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
     return optax.lion(lr, weight_decay=weight_decay, mask=mask)
 
 
+def _adafactor(lr: ScalarOrSchedule, momentum: float, weight_decay: float,
+               mask=None):
+    # Adafactor: the memory-frugal LM-pretraining standard — second
+    # moments stored FACTORED (row + column vectors instead of a full
+    # matrix), so optimizer HBM for a [m, n] kernel drops from O(m*n) to
+    # O(m + n).  Momentum off (the memory-saving configuration) and
+    # update clipping per the paper; coupled decay keeps the factory's
+    # torch-style convention for the non-decoupled names.
+    return _with_coupled_decay(
+        optax.adafactor(lr, multiply_by_parameter_scale=False,
+                        clipping_threshold=1.0),
+        weight_decay, mask,
+    )
+
+
 # The first five names are the reference set (ref: src/trainer.py:123-138);
-# lamb/lion extend it for the north-star large-batch/large-model configs.
+# lamb/lion/adafactor extend it for the north-star large-batch/large-model
+# configs.
 OPTIMIZERS = {
     "sgd": _sgd,
     "adam": _adam,
@@ -91,6 +107,7 @@ OPTIMIZERS = {
     "adamw": _adamw,
     "lamb": _lamb,
     "lion": _lion,
+    "adafactor": _adafactor,
 }
 
 

@@ -1,0 +1,111 @@
+"""Flash-attention block-size sweep on the chip.
+
+The kernel's ``block_q``/``block_k`` default to 128×128 — chosen for
+tile legality, never measured.  This sweeps the grid over the GPT-2
+north-star shape (and any ``--shape``), timing forward and
+forward+backward per geometry, and records the table + the best choice
+to docs/flash_block_tune.json.  If a non-default geometry wins by more
+than ~5%, ops/attention.py's defaults should follow the data.
+
+    python scripts/flash_tune.py
+    python scripts/flash_tune.py --shape 8,12,1024,64 --blocks 128,256,512
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from ml_trainer_tpu.ops.attention import flash_attention  # noqa: E402
+from ml_trainer_tpu.utils.profiler import force  # noqa: E402
+
+
+def bench(fn, *args, iters=30):
+    """Data-dependent chained timing (see validate_flash_tpu.py: in-order
+    completion cannot be assumed on this platform)."""
+    @jax.jit
+    def run_n(first, *rest):
+        def body(carry, _):
+            out = fn(carry, *rest)
+            leaf = jnp.ravel(jax.tree.leaves(out)[0])[0]
+            return first + (leaf * 0).astype(first.dtype), None
+
+        carry, _ = jax.lax.scan(body, first, None, length=iters)
+        return carry
+
+    force(run_n(*args))
+    t0 = time.perf_counter()
+    force(run_n(*args))
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--shape", default="8,12,1024,64",
+                    help="B,H,S,D (default: the GPT-2 124M bench shape)")
+    ap.add_argument("--blocks", default="128,256,512")
+    ap.add_argument("--dtype", default="bfloat16")
+    args = ap.parse_args()
+    assert jax.default_backend() == "tpu", (
+        f"needs the chip, got {jax.default_backend()}"
+    )
+    b, h, s, d = (int(x) for x in args.shape.split(","))
+    blocks = [int(x) for x in args.blocks.split(",")]
+    dtype = jnp.dtype(args.dtype)
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        jnp.asarray(rng.normal(size=(b, h, s, d)) * 0.5, dtype)
+        for _ in range(3)
+    )
+
+    rows = []
+    for bq, bk in itertools.product(blocks, blocks):
+        if s % bq or s % bk:
+            continue
+
+        def fwd(q, k, v, _bq=bq, _bk=bk):
+            return flash_attention(q, k, v, None, True, None, _bq, _bk)
+
+        def loss(q, k, v, _bq=bq, _bk=bk):
+            return flash_attention(
+                q, k, v, None, True, None, _bq, _bk
+            ).sum().astype(jnp.float32)
+
+        grad = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        try:
+            row = {
+                "block_q": bq, "block_k": bk,
+                "fwd_ms": round(bench(jax.jit(fwd), q, k, v) * 1e3, 3),
+                "fwd_bwd_ms": round(bench(grad, q, k, v) * 1e3, 3),
+            }
+        except Exception as e:  # geometry rejected by Mosaic (VMEM etc.)
+            row = {"block_q": bq, "block_k": bk,
+                   "error": str(e).splitlines()[0][:160]}
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
+    timed = [r for r in rows if "fwd_bwd_ms" in r]
+    best = min(timed, key=lambda r: r["fwd_bwd_ms"]) if timed else None
+    record = {
+        "device": str(jax.devices()[0]),
+        "shape": [b, h, s, d], "dtype": str(dtype),
+        "rows": rows, "best": best,
+        "default": {"block_q": 128, "block_k": 128},
+    }
+    out = os.path.join(ROOT, "docs", "flash_block_tune.json")
+    with open(out, "w") as fp:
+        json.dump(record, fp, indent=1)
+    print(f"-> {out} best={best}")
+
+
+if __name__ == "__main__":
+    main()

@@ -77,8 +77,13 @@ def measure(model_name: str, batch: int) -> dict:
         rng=jax.random.PRNGKey(1),
     )
 
+    has_bs = bool(variables.get("batch_stats", {}))
+
     def step(state, x, y):
         def loss_fn(p):
+            if not has_bs:  # ViT/BERT-class: no BatchNorm collection
+                out = model.apply({"params": p}, x, train=True)
+                return criterion(out, y), state.batch_stats
             out, mut = model.apply(
                 {"params": p, "batch_stats": state.batch_stats},
                 x, train=True, mutable=["batch_stats"],

@@ -55,6 +55,13 @@ r50_batch_done() {
   grep -hqE "\"model\": \"resnet50\", \"batch_shape\": \[$1, [^}]*\"backend\": \"tpu\"" \
     "$OUT"/one_resnet50_b$1.out 2>/dev/null
 }
+tune_done() {
+  python - <<'EOF' 2>/dev/null
+import json, sys
+rec = json.load(open("docs/flash_block_tune.json"))
+sys.exit(0 if rec.get("best") and "TPU" in rec.get("device", "") else 1)
+EOF
+}
 ledger_done() {
   python - <<'EOF' 2>/dev/null
 import json, sys
@@ -92,6 +99,7 @@ if [ "${1:-}" = "--check" ]; then
   for m in resnet50 vit_b16 bert_base gpt2; do model_done "$m" || exit 1; done
   for b in 128 256; do r50_batch_done "$b" || exit 1; done
   ledger_done || exit 1
+  tune_done || exit 1
   golden_done || exit 1
   flash_done || exit 1
   notebook_done 01 || exit 1
@@ -167,6 +175,13 @@ if ledger_done; then
 else
   echo "== 2c. resnet50 MFU roofline ledger =="
   run_stage 1500 "$OUT/ledger.out" python scripts/mfu_ledger.py || true
+fi
+
+if tune_done; then
+  echo "== 2d. flash block tune: already recorded, skipping =="
+else
+  echo "== 2d. flash-attention block-size sweep (GPT-2 shape) =="
+  run_stage 1200 "$OUT/flash_tune.out" python scripts/flash_tune.py || true
 fi
 
 if golden_done; then

@@ -18,7 +18,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   # here only catches a manually launched recovery — which is the point.
   # Patterns are anchored to interpreter invocations so an editor or grep
   # with one of these filenames in its argv does not park the watcher.)
-  if pgrep -f "python[0-9.]* ([^ ]*/)?(bench\.py|validate_flash_tpu\.py|mfu_ledger\.py|make_notebooks\.py|01_local_training\.py)|bash ([^ ]*/)?(tpu_runbook\.sh|tpu_recover\.sh)$" >/dev/null 2>&1; then
+  if pgrep -f "python[0-9.]* ([^ ]*/)?(bench\.py|validate_flash_tpu\.py|mfu_ledger\.py|flash_tune\.py|make_notebooks\.py|01_local_training\.py)|bash ([^ ]*/)?(tpu_runbook\.sh|tpu_recover\.sh)$" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) busy: another TPU client running" >> "$LOG"
     sleep 300
     continue

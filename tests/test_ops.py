@@ -21,7 +21,8 @@ from ml_trainer_tpu.ops import (
 # --------------------------------------------------------------- optimizers
 @pytest.mark.parametrize(
     "name",
-    ["sgd", "adam", "adagrad", "adamax", "adamw", "lamb", "lion"],
+    ["sgd", "adam", "adagrad", "adamax", "adamw", "lamb", "lion",
+     "adafactor"],
 )
 def test_optimizer_step_changes_params(name):
     tx = get_optimizer(name, 0.1, momentum=0.9, weight_decay=0.01)
@@ -31,6 +32,25 @@ def test_optimizer_step_changes_params(name):
     updates, _ = tx.update(grads, state, params)
     new = jax.tree.map(lambda p, u: p + u, params, updates)
     assert not np.allclose(new["w"], params["w"])
+
+
+def test_adafactor_factors_second_moment():
+    """The reason adafactor is in the registry: a [m, n] kernel's second
+    moment is stored as row + column vectors (O(m+n)), not a full [m, n]
+    matrix like adam's nu — the LM-pretraining memory win.  (Factoring
+    engages for dims >= optax's min_dim_size_to_factor=128, i.e. the
+    transformer-kernel sizes it exists for.)"""
+    m, n = 256, 512
+    params = {"w": jnp.ones((m, n))}
+    count = lambda tree: sum(  # noqa: E731
+        np.prod(leaf.shape)
+        for leaf in jax.tree.leaves(tree)
+        if hasattr(leaf, "shape")
+    )
+    ada = count(get_optimizer("adafactor", 0.1).init(params))
+    adam = count(get_optimizer("adam", 0.1).init(params))
+    assert adam >= 2 * m * n  # mu + nu, both full
+    assert ada < m * n  # factored: no full-matrix buffer at all
 
 
 def test_sgd_matches_torch_semantics():

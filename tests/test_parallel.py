@@ -16,7 +16,6 @@ from ml_trainer_tpu.models import get_model
 from ml_trainer_tpu.parallel import (
     batch_sharding,
     create_mesh,
-    mesh_shape_for,
     ring_attention,
     rules_for,
 )
