@@ -7,6 +7,14 @@ when the pickle batches are on disk, synthetic data otherwise.
 """
 
 import os
+import sys
+
+# Runnable directly (`python examples/<name>.py`): the repo root is
+# not on sys.path in that invocation (only the script's own dir is).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 
 from ml_trainer_tpu import (
     MLModel,

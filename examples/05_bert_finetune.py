@@ -12,6 +12,14 @@ offline hash tokenizer stands in for a downloaded vocab).
 """
 
 import os
+import sys
+
+# Runnable directly (`python examples/<name>.py`): the repo root is
+# not on sys.path in that invocation (only the script's own dir is).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 
 from ml_trainer_tpu import Trainer
 from ml_trainer_tpu.data.text import TokenizedDataset, load_sst2_tsv

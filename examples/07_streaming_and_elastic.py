@@ -21,6 +21,14 @@ practical:
 """
 
 import os
+import sys
+
+# Runnable directly (`python examples/<name>.py`): the repo root is
+# not on sys.path in that invocation (only the script's own dir is).
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
 import tempfile
 
 import numpy as np

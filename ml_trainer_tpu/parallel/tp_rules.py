@@ -30,6 +30,16 @@ TRANSFORMER_TP_RULES = [
     (r"mlp/fc_in/kernel$", P(None, "tensor")),
     (r"mlp/fc_in/bias$", P("tensor")),
     (r"mlp/fc_out/kernel$", P("tensor", None)),
+    # llama family (models/llama.py): separate q/k/v projections
+    # column-parallel (GQA caveat: the tensor degree should divide
+    # num_kv_heads, or the narrow k/v kernels split mid-head), SwiGLU
+    # gate/up column-parallel, down row-parallel, untied lm_head
+    # column-parallel over the vocab dim (32000-class vocabs divide
+    # cleanly, unlike GPT-2's 50257).
+    (r"attn/(q|k|v)/kernel$", P(None, "tensor")),
+    (r"block\d+/(gate|up)/kernel$", P(None, "tensor")),
+    (r"block\d+/down/kernel$", P("tensor", None)),
+    (r"lm_head$", P(None, "tensor")),
     # embeddings: shard the FEATURE dim.  Vocab-dim (Megatron-row) sharding
     # would need the vocab padded to a multiple of the tensor degree —
     # GPT-2's 50257 is not — so the embed dim (a multiple of the head count)
@@ -48,6 +58,8 @@ TRANSFORMER_TP_RULES = [
 FSDP_RULES = [
     (r"kernel$", P("fsdp", None)),
     (r"embedding$", P(None, "fsdp")),
+    # llama's untied head is a raw [E, V] param (no /kernel suffix).
+    (r"lm_head$", P("fsdp", None)),
 ]
 
 # Expert parallelism: the stacked MoE expert weights [E, ...] shard their

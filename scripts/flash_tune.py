@@ -24,28 +24,12 @@ import numpy as np
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from ml_trainer_tpu.ops.attention import flash_attention  # noqa: E402
-from ml_trainer_tpu.utils.profiler import force  # noqa: E402
-
-
-def bench(fn, *args, iters=30):
-    """Data-dependent chained timing (see validate_flash_tpu.py: in-order
-    completion cannot be assumed on this platform)."""
-    @jax.jit
-    def run_n(first, *rest):
-        def body(carry, _):
-            out = fn(carry, *rest)
-            leaf = jnp.ravel(jax.tree.leaves(out)[0])[0]
-            return first + (leaf * 0).astype(first.dtype), None
-
-        carry, _ = jax.lax.scan(body, first, None, length=iters)
-        return carry
-
-    force(run_n(*args))
-    t0 = time.perf_counter()
-    force(run_n(*args))
-    return (time.perf_counter() - t0) / iters
+# ONE definition of the data-dependent chained timing harness (in-order
+# completion cannot be assumed on this platform): reuse it, never fork it.
+from validate_flash_tpu import bench  # noqa: E402
 
 
 def main():

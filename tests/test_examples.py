@@ -109,6 +109,11 @@ def test_example_06_long_context(monkeypatch, tmp_path):
     assert (tmp_path / "lc" / "history.pkl").exists()
 
 
+@pytest.mark.parametrize("model", ["gpt2_tiny", "llama_tiny"])
+def test_example_08_generation(monkeypatch, tmp_path, model):
+    run_example("08_generation.py", monkeypatch, tmp_path, {"MODEL": model})
+
+
 def test_example_07_streaming_and_elastic(monkeypatch, tmp_path):
     run_example("07_streaming_and_elastic.py", monkeypatch, tmp_path, {
         "MODEL_DIR": str(tmp_path / "sr"), "EPOCHS": "1",
