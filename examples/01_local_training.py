@@ -6,14 +6,8 @@ load_model → test), driving the TPU instead of CPU/GPU.  Uses real CIFAR-10
 when the pickle batches are on disk, synthetic data otherwise.
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
-import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 
 from ml_trainer_tpu import (

@@ -18,14 +18,8 @@ To rehearse without TPU hardware (the local_gpu/gloo analog, SURVEY.md §4):
         python examples/02_distributed_training.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
-import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 
 from ml_trainer_tpu import Trainer

@@ -7,14 +7,9 @@ reference torch ``model.pth`` checkpoint (the ``module.``-prefix-tolerant
 import, ref: src/utils/utils.py:15-28).
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
 import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 from ml_trainer_tpu import MLModel, Loader, Trainer, load_model
 from ml_trainer_tpu.data import CIFAR10, SyntheticCIFAR10

@@ -12,14 +12,8 @@ Levers (env vars): ACCUM (microbatches per update, compiled scan), REMAT
 K (steps per dispatch), TP (tensor-parallel degree over a dp*tp mesh).
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
-import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 
 import jax

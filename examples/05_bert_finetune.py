@@ -11,14 +11,8 @@ offline hash tokenizer stands in for a downloaded vocab).
         python examples/05_bert_finetune.py                   # the real one
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
-import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 
 from ml_trainer_tpu import Trainer

@@ -20,14 +20,8 @@ All three levers are math-preserving: the trajectory equals the dense
 single-device run (tests/test_parallel.py::test_long_context_stack_composes).
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
-import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 
 from ml_trainer_tpu import Trainer

@@ -12,14 +12,8 @@ grouped-query-attention cache that stores only num_kv_heads-wide K/V.
     MODEL=llama_tiny python examples/08_generation.py
 """
 
+import _bootstrap  # noqa: F401  (repo root onto sys.path)
 import os
-import sys
-
-# Runnable directly (`python examples/<name>.py`): the repo root is
-# not on sys.path in that invocation (only the script's own dir is).
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
 
 
 import jax

@@ -1,0 +1,15 @@
+"""Shared example bootstrap: put the repo root on sys.path.
+
+``python examples/<name>.py`` puts only the script's own directory on
+sys.path, so ``ml_trainer_tpu`` would not resolve; every example does
+``import _bootstrap`` (this module lives next to them, hence importable
+in exactly that situation, and under runpy.run_path too) and gets the
+repo root inserted once.
+"""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

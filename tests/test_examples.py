@@ -28,6 +28,9 @@ def run_example(name, monkeypatch, tmp_path, env):
     # The scripts read sys.argv (03 takes an optional checkpoint path);
     # pytest's own argv must not leak into them.
     monkeypatch.setattr(sys, "argv", [name])
+    # Direct invocation puts the script's dir on sys.path (that is how
+    # `import _bootstrap` resolves); runpy.run_path does NOT — mirror it.
+    monkeypatch.syspath_prepend(EXAMPLES)
     runpy.run_path(os.path.join(EXAMPLES, name), run_name="__main__")
 
 

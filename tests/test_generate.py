@@ -213,6 +213,36 @@ def test_generate_ragged_matches_per_length_generate():
     np.testing.assert_array_equal(outs[1], ref5[0])
 
 
+def test_generate_ragged_default_rng_folds_per_bucket():
+    """With temperature > 0 and NO rng given, buckets must still draw
+    independent key streams: the default rng is materialized inside
+    generate_ragged so the per-bucket fold_in applies (omitting it would
+    hand every bucket generate()'s identical PRNGKey(0) default).
+    Pinned by equivalence: rng=None == rng=PRNGKey(0) explicitly."""
+    import jax
+    import numpy as np
+
+    from ml_trainer_tpu.generate import generate_ragged
+    from ml_trainer_tpu.models import get_model
+
+    m = get_model("gpt2_tiny", max_len=64)
+    variables = m.init({"params": jax.random.PRNGKey(0)},
+                       np.zeros((1, 8), np.int32), train=False)
+    prompts = [
+        np.asarray([5, 6, 7], np.int32),
+        np.asarray([9, 10, 11, 12, 13], np.int32),
+    ]
+    default = generate_ragged(
+        m, variables, prompts, max_new_tokens=4, temperature=0.9
+    )
+    explicit = generate_ragged(
+        m, variables, prompts, max_new_tokens=4, temperature=0.9,
+        rng=jax.random.PRNGKey(0),
+    )
+    for a, b in zip(default, explicit):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_generate_ragged_pads_batch_to_power_of_two():
     """A group of 3 same-length prompts runs as a padded batch of 4; the
     real rows must match the unpadded batch result and no padding row
