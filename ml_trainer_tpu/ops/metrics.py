@@ -1,4 +1,6 @@
-"""Metric engine: 'accuracy' and 'mcrmse', computed on-device.
+"""Metric engine, computed on-device: the reference pair
+('accuracy'/'mcrmse') plus 'top5_accuracy', 'f1' and 'perplexity' for
+the north-star model families.
 
 Reference semantics (ref: src/trainer.py:160-166):
 
@@ -17,6 +19,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ml_trainer_tpu.ops.predictions import get_predictions
@@ -32,16 +35,74 @@ def mcrmse(outputs, targets, pred_function: Optional[Callable] = None):
     return jnp.mean(jnp.sqrt(colwise_mse), axis=0)
 
 
+def top5_accuracy(outputs, targets, pred_function: Optional[Callable] = None):
+    """Target appears in the 5 highest-scoring classes — the ImageNet
+    companion metric to top-1 (north-star configs[1..3]).  Monotone
+    pred-fns (softmax/logsoftmax) do not change the ranking, so raw
+    outputs are ranked directly (lax.top_k: partial selection, not a
+    full 1000-class sort per row)."""
+    _, top5 = jax.lax.top_k(outputs, 5)
+    return jnp.mean(
+        jnp.any(top5 == targets[..., None], axis=-1).astype(jnp.float32)
+    )
+
+
+def f1(outputs, targets, pred_function: Optional[Callable] = None):
+    """PER-BATCH binary F1 on class-1 (the SST-2 convention), from
+    on-device TP/FP/FN counts; 0 when the batch has no positives (the
+    empty-harmonic-mean convention sklearn uses).
+
+    The engine reports the mean of this over batches — which equals
+    sklearn's DATASET F1 only within a batch, not across batches (F1 is
+    not linear in its counts: a batch-less corpus F1 needs the summed
+    counts).  The trainer's scalar accumulator keeps the reference's
+    running-average semantics (ref: src/trainer.py:193-194, 200-203), so
+    this metric is a training-progress signal; for an exact corpus F1,
+    run ``Trainer.predict()`` and score the collected predictions."""
+    predictions = get_predictions(outputs, pred_function)
+    pred_pos = (predictions == 1).astype(jnp.float32)
+    true_pos = (targets == 1).astype(jnp.float32)
+    tp = jnp.sum(pred_pos * true_pos)
+    denom = jnp.sum(pred_pos) + jnp.sum(true_pos)  # 2TP + FP + FN
+    return jnp.where(denom > 0, 2.0 * tp / denom, 0.0)
+
+
+def _mean_token_nll(outputs, targets, pred_function: Optional[Callable] = None):
+    """Per-batch mean token negative log-likelihood ([B, S, V] logits vs
+    [B, S] next-token ids) — perplexity's accumulator."""
+    logprobs = jax.nn.log_softmax(outputs.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logprobs, targets[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
+# A metric may carry a ``finalize`` attribute: the engine accumulates
+# the fn's scalar across batches, averages, then applies the finalizer
+# to the EPOCH value — which is what makes nonlinear report metrics
+# honest: 'perplexity' accumulates mean NLL (linear, so the epoch mean
+# is the corpus mean over equal-size batches) and exponentiates once at
+# the end, exp(mean nll) — NOT the Jensen-inflated mean of per-batch
+# exp(nll) a naive per-batch metric would produce.  Attribute (not a
+# tuple in the table) so METRICS values stay plain callables for any
+# direct-dispatch consumer.
+_mean_token_nll.finalize = jnp.exp
+
 METRICS = {
     "accuracy": accuracy,
     "mcrmse": mcrmse,
+    "top5_accuracy": top5_accuracy,
+    "f1": f1,
+    "perplexity": _mean_token_nll,
 }
 
 
 def get_metric(
     name: Optional[str], pred_function: Optional[Callable] = None
 ) -> Optional[Callable]:
-    """Bind a metric by name; ``None`` disables metrics (ref: main.py:70-71)."""
+    """Bind a metric by name; ``None`` disables metrics (ref: main.py:70-71).
+
+    The returned callable carries a ``finalize`` attribute (identity for
+    linear metrics) that the engine applies to the averaged epoch value
+    — see the METRICS table."""
     if name is None:
         return None
     try:
@@ -50,4 +111,11 @@ def get_metric(
         raise ValueError(
             f"Unknown metric {name!r}; expected one of {sorted(METRICS)}"
         ) from None
-    return lambda outputs, targets: fn(outputs, targets, pred_function)
+
+    def bound(outputs, targets):
+        return fn(outputs, targets, pred_function)
+
+    finalize = getattr(fn, "finalize", None)
+    if finalize is not None:
+        bound.finalize = finalize
+    return bound

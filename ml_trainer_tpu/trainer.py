@@ -411,6 +411,13 @@ class Trainer:
         )
         self.pred_function = get_prediction_function(cfg.pred_function)
         self.metric_fn = get_metric(cfg.metric, self.pred_function)
+        # Epoch finalizer for nonlinear report metrics (e.g. perplexity
+        # accumulates mean NLL and exponentiates ONCE per epoch — see
+        # ops/metrics.py METRICS); identity for the linear ones.
+        _fin = getattr(self.metric_fn, "finalize", None)
+        self._metric_finalize = (
+            (lambda v: float(_fin(v))) if _fin is not None else (lambda v: v)
+        )
         if self._takes_targets and self.metric_fn is not None:
             raise ValueError(
                 "metric must be None for models that compute their own "
@@ -922,6 +929,18 @@ class Trainer:
             variables["batch_stats"] = self.state.batch_stats
         return variables
 
+    def _postfix_metric(self, metric_sum, seen: int, n: int) -> float:
+        """Progress-bar metric value.  Linear metrics keep the reference's
+        running-average-over-full-epoch display quirk
+        (ref: src/trainer.py:193-194); metrics with an epoch finalizer
+        must divide by the batches actually SEEN before finalizing —
+        exponentiating a partial sum over the full count would display a
+        number with no interpretation (it would climb from ~exp(0) all
+        epoch)."""
+        if getattr(self.metric_fn, "finalize", None) is not None:
+            return self._metric_finalize(float(metric_sum) / max(seen, 1))
+        return float(metric_sum) / n
+
     # ------------------------------------------------------------------ loops
     def _train_one_epoch(self, epoch: int) -> None:
         self.train_loader.set_epoch(epoch - 1)
@@ -950,13 +969,13 @@ class Trainer:
                         if self.metric:
                             tepoch.set_postfix(
                                 loss=float(loss_sum) / n,
-                                metric=float(metric_sum) / n,
+                                metric=self._postfix_metric(metric_sum, i + 1, n),
                             )
                         else:
                             tepoch.set_postfix(loss=float(loss))
         self.train_losses.append(float(loss_sum) / n)
         if self.metric:
-            self.train_metrics.append(float(metric_sum) / n)
+            self.train_metrics.append(self._metric_finalize(float(metric_sum) / n))
 
     def _train_one_epoch_multi(self, n: int, lr_scale):
         """Epoch driven K optimizer steps per dispatch: full chunks of
@@ -979,7 +998,8 @@ class Trainer:
                 if done % max(self.log_every, k) < step_n or done == n:
                     if self.metric:
                         tepoch.set_postfix(
-                            loss=float(loss_sum) / n, metric=float(metric_sum) / n
+                            loss=float(loss_sum) / n,
+                            metric=self._postfix_metric(metric_sum, done, n),
                         )
                     else:
                         # Mean loss of the last dispatch — the multi-step
@@ -1024,7 +1044,7 @@ class Trainer:
                         if self.metric:
                             tepoch.set_postfix(
                                 loss=float(loss_sum) / n,
-                                metric=float(metric_sum) / n,
+                                metric=self._postfix_metric(metric_sum, done, n),
                             )
                         else:
                             # Mean loss of the last dispatch — the analog of
@@ -1063,13 +1083,13 @@ class Trainer:
                         if self.metric:
                             tepoch.set_postfix(
                                 loss=float(loss_sum) / n,
-                                metric=float(metric_sum) / n,
+                                metric=self._postfix_metric(metric_sum, i + 1, n),
                             )
                         else:
                             tepoch.set_postfix(loss=float(loss))
         self.val_losses.append(float(loss_sum) / n)
         if self.metric:
-            self.val_metrics.append(float(metric_sum) / n)
+            self.val_metrics.append(self._metric_finalize(float(metric_sum) / n))
 
     # ------------------------------------------------------------------- fit
     def fit(self, resume: bool = False) -> None:
@@ -1372,13 +1392,14 @@ class Trainer:
                 if (i + 1) % self.log_every == 0 or (i + 1) == n:
                     if self.metric:
                         tepoch.set_postfix(
-                            loss=float(loss_sum) / n, metric=float(metric_sum) / n
+                            loss=float(loss_sum) / n,
+                            metric=self._postfix_metric(metric_sum, i + 1, n),
                         )
                     else:
                         tepoch.set_postfix(loss=float(loss))
         test_loss = float(loss_sum) / n
         if self.metric:
-            return test_loss, float(metric_sum) / n
+            return test_loss, self._metric_finalize(float(metric_sum) / n)
         return test_loss
 
     def _place_eval_batch(self, batch):

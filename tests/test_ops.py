@@ -195,6 +195,56 @@ def test_metric_none_disabled():
     assert get_metric(None) is None
 
 
+def test_f1_matches_sklearn():
+    sklearn = pytest.importorskip("sklearn")  # not a declared dependency
+    from sklearn.metrics import f1_score
+
+    assert sklearn is not None
+
+    rng = np.random.default_rng(3)
+    out = rng.normal(size=(64, 2)).astype(np.float32)
+    tgt = rng.integers(0, 2, size=(64,))
+    preds = out.argmax(-1)
+    metric = get_metric("f1", get_prediction_function("softmax"))
+    got = float(metric(jnp.asarray(out), jnp.asarray(tgt)))
+    assert got == pytest.approx(f1_score(tgt, preds), abs=1e-6)
+    # No positives anywhere -> 0 by convention, not NaN.
+    zeros = jnp.asarray([[1.0, 0.0]] * 4)
+    assert float(metric(zeros, jnp.zeros((4,), jnp.int32))) == 0.0
+
+
+def test_top5_accuracy():
+    rng = np.random.default_rng(4)
+    out = rng.normal(size=(32, 10)).astype(np.float32)
+    tgt = rng.integers(0, 10, size=(32,))
+    expected = np.mean([
+        t in np.argsort(o)[-5:] for o, t in zip(out, tgt)
+    ])
+    metric = get_metric("top5_accuracy")
+    assert float(metric(jnp.asarray(out), jnp.asarray(tgt))) == pytest.approx(
+        expected
+    )
+
+
+def test_perplexity_uniform_is_vocab_size():
+    """Uniform logits predict every token with prob 1/V -> ppl == V.
+    The metric ACCUMULATES mean NLL; the engine's epoch finalizer
+    exponentiates once — exp(mean nll), not mean(exp(nll)): averaging
+    per-batch perplexities would Jensen-inflate the corpus number."""
+    v = 17
+    out = jnp.zeros((2, 8, v))
+    tgt = jnp.ones((2, 8), jnp.int32)
+    metric = get_metric("perplexity")
+    per_batch = float(metric(out, tgt))
+    assert per_batch == pytest.approx(np.log(v), rel=1e-5)  # mean NLL
+    assert float(metric.finalize(per_batch)) == pytest.approx(v, rel=1e-5)
+    # Two unequal-difficulty batches: finalize(mean) is the corpus ppl.
+    nlls = [1.0, 3.0]
+    corpus = float(metric.finalize(np.mean(nlls)))
+    assert corpus == pytest.approx(np.exp(2.0))
+    assert corpus < np.mean([np.exp(x) for x in nlls])  # Jensen gap
+
+
 # -------------------------------------------------------------- predictions
 def test_prediction_functions():
     x = jnp.asarray([[1.0, 3.0, 2.0]])

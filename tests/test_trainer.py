@@ -140,6 +140,27 @@ def test_resume_from_checkpoint(tmp_path):
     assert t2.history["train_loss"][:2] == pytest.approx(t1.train_losses, abs=1e-6)
 
 
+def test_perplexity_metric_finalized_at_epoch_level(tmp_path):
+    """The engine applies the metric's epoch finalizer: with
+    metric='perplexity' the recorded value is exp(mean NLL) — on the LM
+    path where loss IS mean NLL, history metric == exp(history loss)."""
+    from ml_trainer_tpu.data import SyntheticTokens
+    from ml_trainer_tpu.models import get_model
+
+    ds = SyntheticTokens(size=16, seq_len=16, vocab_size=256, seed=0)
+    t = Trainer(
+        get_model("gpt2_tiny", vocab_size=256), datasets=(ds, ds), epochs=1,
+        batch_size=8, model_dir=str(tmp_path), metric="perplexity",
+        optimizer="adamw", lr=0.001, criterion="cross_entropy",
+    )
+    t.fit()
+    # Not exactly equal (loss averages per-batch means; the metric path
+    # recomputes from logits) but exp() must have been applied once:
+    assert t.train_metrics[0] == pytest.approx(
+        float(np.exp(t.train_losses[0])), rel=1e-3
+    )
+
+
 def test_seed_reproducibility(tmp_path):
     a = make_trainer(tmp_path / "a", epochs=1, seed=5)
     a.fit()
