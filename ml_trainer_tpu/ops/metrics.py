@@ -40,8 +40,10 @@ def top5_accuracy(outputs, targets, pred_function: Optional[Callable] = None):
     companion metric to top-1 (north-star configs[1..3]).  Monotone
     pred-fns (softmax/logsoftmax) do not change the ranking, so raw
     outputs are ranked directly (lax.top_k: partial selection, not a
-    full 1000-class sort per row)."""
-    _, top5 = jax.lax.top_k(outputs, 5)
+    full 1000-class sort per row).  Fewer than 5 classes degenerates to
+    plain membership of the full set (k clamps) rather than a trace-time
+    crash deep inside the compiled step."""
+    _, top5 = jax.lax.top_k(outputs, min(5, outputs.shape[-1]))
     return jnp.mean(
         jnp.any(top5 == targets[..., None], axis=-1).astype(jnp.float32)
     )
@@ -100,9 +102,11 @@ def get_metric(
 ) -> Optional[Callable]:
     """Bind a metric by name; ``None`` disables metrics (ref: main.py:70-71).
 
-    The returned callable carries a ``finalize`` attribute (identity for
-    linear metrics) that the engine applies to the averaged epoch value
-    — see the METRICS table."""
+    When the underlying metric is nonlinear it carries a ``finalize``
+    attribute, propagated onto the returned callable, that the engine
+    applies to the averaged epoch value; linear metrics carry NO such
+    attribute — consumers probe with ``getattr(fn, "finalize", None)``
+    (as the trainer does).  See the METRICS table."""
     if name is None:
         return None
     try:

@@ -947,6 +947,7 @@ class Trainer:
         n = len(self.train_loader)
         loss_sum = jnp.zeros(())
         metric_sum = jnp.zeros(())
+        epoch_t0 = time.time()
         lr_scale = jnp.asarray(self._lr_scale, jnp.float32)
         if self.steps_per_execution > 1:
             loss_sum, metric_sum = self._train_one_epoch_multi(n, lr_scale)
@@ -973,7 +974,14 @@ class Trainer:
                             )
                         else:
                             tepoch.set_postfix(loss=float(loss))
+        # float(loss_sum) above fenced the device work, so this timestamp
+        # covers actual execution, not async dispatch.
         self.train_losses.append(float(loss_sum) / n)
+        dt = time.time() - epoch_t0
+        logger.info(
+            f"Epoch {epoch}: {n * self.global_batch / max(dt, 1e-9):,.0f} "
+            f"samples/s ({dt:.1f}s, global batch {self.global_batch})"
+        )
         if self.metric:
             self.train_metrics.append(self._metric_finalize(float(metric_sum) / n))
 
