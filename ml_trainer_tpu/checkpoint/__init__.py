@@ -14,6 +14,7 @@ from ml_trainer_tpu.checkpoint.checkpoint import (
     wait_for_checkpoints,
 )
 from ml_trainer_tpu.checkpoint.torch_import import load_torch_checkpoint
+from ml_trainer_tpu.checkpoint.torch_export import save_torch_checkpoint
 
 __all__ = [
     "CHECKPOINT_PREFIX",
@@ -30,4 +31,5 @@ __all__ = [
     "write_model_bytes",
     "wait_for_checkpoints",
     "load_torch_checkpoint",
+    "save_torch_checkpoint",
 ]

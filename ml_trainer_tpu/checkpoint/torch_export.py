@@ -1,0 +1,120 @@
+"""Export flax parameter trees back to reference-style torch checkpoints.
+
+The inverse of ``torch_import``: a model trained here can hand its
+weights back to the reference stack (or any torch consumer) as the
+``model.pth`` ``state_dict`` the reference's ``load_model`` reads
+(ref: src/utils/utils.py:15-28) — migration runs in BOTH directions.
+
+Layout conversions mirror the import exactly:
+
+* conv kernels: flax HWIO -> torch OIHW;
+* dense kernels: flax (in, out) -> torch (out, in);
+* the first dense after a conv stack un-permutes its input features from
+  this framework's H·W·C flatten order back to torch's C·H·W
+  (``spatial_inputs``, same table as the import — MLModel's ``fc1``);
+* BatchNorm ``scale``/``mean``/``var`` -> ``weight``/``running_mean``/
+  ``running_var``.
+
+Round-trip identity (export then import == original tree) is test-pinned
+(tests/test_checkpoint.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.checkpoint.torch_import import MLMODEL_SPATIAL_INPUTS
+
+
+def convert_to_torch_state_dict(
+    params: Mapping[str, Mapping[str, np.ndarray]],
+    spatial_inputs: Optional[Dict[str, Tuple[int, int, int]]] = None,
+    ddp_prefix: bool = False,
+) -> Dict[str, np.ndarray]:
+    """flax ``{layer: {kernel/bias/...}}`` -> torch ``{layer.weight: ...}``.
+
+    ``ddp_prefix=True`` writes ``module.``-prefixed keys — the form a
+    DDP-trained reference checkpoint carries (its ``load_model`` strips
+    them, so either form loads there)."""
+    spatial_inputs = (
+        MLMODEL_SPATIAL_INPUTS if spatial_inputs is None else spatial_inputs
+    )
+    out: Dict[str, np.ndarray] = {}
+    prefix = "module." if ddp_prefix else ""
+
+    def put(layer: str, leaf: str, arr: np.ndarray) -> None:
+        out[f"{prefix}{layer.replace('/', '.')}.{leaf}"] = arr
+
+    for layer, leaves in params.items():
+        for leaf, value in leaves.items():
+            if isinstance(value, Mapping):
+                raise ValueError(
+                    f"nested module {layer}/{leaf}: flatten the tree to "
+                    "{layer: {leaf: array}} first (transformer trees need "
+                    "a model-specific key mapping, not this generic one)"
+                )
+            arr = np.asarray(value)
+            if leaf == "kernel":
+                if arr.ndim == 4:  # HWIO -> OIHW
+                    put(layer, "weight", arr.transpose(3, 2, 0, 1))
+                elif arr.ndim == 2:
+                    w = arr.T  # (in, out) -> (out, in)
+                    if layer in spatial_inputs:
+                        c, h, w_ = spatial_inputs[layer]
+                        # Columns are H*W*C-ordered here; torch flattens
+                        # C*H*W — permute back.
+                        w = (
+                            w.reshape(w.shape[0], h, w_, c)
+                            .transpose(0, 3, 1, 2)
+                            .reshape(w.shape[0], c * h * w_)
+                        )
+                    put(layer, "weight", w)
+                else:
+                    # A silent pass-through would write a wrong-layout
+                    # tensor torch loads without error (and a 1-D kernel
+                    # would import back as 'scale', breaking the
+                    # round-trip identity) — refuse loudly instead.
+                    raise ValueError(
+                        f"{layer}/kernel has rank {arr.ndim}; only dense "
+                        "(2-D) and conv (4-D HWIO) kernels have a defined "
+                        "torch export layout"
+                    )
+            elif leaf == "scale":
+                put(layer, "weight", arr)
+            elif leaf == "mean":
+                put(layer, "running_mean", arr)
+            elif leaf == "var":
+                put(layer, "running_var", arr)
+            else:
+                put(layer, leaf, arr)
+    return out
+
+
+def save_torch_checkpoint(
+    path: str,
+    variables: Mapping,
+    spatial_inputs: Optional[Dict[str, Tuple[int, int, int]]] = None,
+    ddp_prefix: bool = False,
+) -> str:
+    """Write a torch-loadable ``model.pth`` from flax ``variables`` (the
+    ``{'params': ...}`` dict or a bare params tree).  BatchNorm batch
+    stats merge in from ``variables['batch_stats']`` when present."""
+    import torch
+
+    params = dict(variables.get("params", variables))
+    batch_stats = variables.get("batch_stats")
+    if batch_stats:
+        merged: Dict[str, Dict] = {
+            k: dict(v) for k, v in params.items()
+        }
+        for layer, stats in batch_stats.items():
+            merged.setdefault(layer, {}).update(stats)
+        params = merged
+    state = convert_to_torch_state_dict(params, spatial_inputs, ddp_prefix)
+    torch.save(
+        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()},
+        path,
+    )
+    return path
