@@ -113,8 +113,9 @@ def save_torch_checkpoint(
             merged.setdefault(layer, {}).update(stats)
         params = merged
     state = convert_to_torch_state_dict(params, spatial_inputs, ddp_prefix)
+    # np.array (writable copy): torch.from_numpy warns on the read-only
+    # views np.asarray produces from jax arrays.
     torch.save(
-        {k: torch.from_numpy(np.ascontiguousarray(v)) for k, v in state.items()},
-        path,
+        {k: torch.from_numpy(np.array(v)) for k, v in state.items()}, path
     )
     return path
