@@ -103,6 +103,16 @@ def bert_base(num_classes: int = 2, **kw) -> BertEncoder:
     return BertEncoder(num_classes=num_classes, **kw)
 
 
+@register_model("bert_large")
+def bert_large(num_classes: int = 2, **kw) -> BertEncoder:
+    """BERT-large: 24 layers, 1024 wide, 16 heads."""
+    kw.setdefault("embed_dim", 1024)
+    kw.setdefault("depth", 24)
+    kw.setdefault("num_heads", 16)
+    kw.setdefault("mlp_dim", 4096)
+    return BertEncoder(num_classes=num_classes, **kw)
+
+
 @register_model("bert_tiny")
 def bert_tiny(num_classes: int = 2, **kw) -> BertEncoder:
     """Small BERT for tests: 2 layers, 128 wide."""

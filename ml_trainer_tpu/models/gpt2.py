@@ -130,6 +130,24 @@ def gpt2(**kw) -> GPT2:
     return GPT2(**kw)
 
 
+@register_model("gpt2_medium")
+def gpt2_medium(**kw) -> GPT2:
+    """GPT-2 355M: 24 layers, 1024 wide, 16 heads."""
+    kw.setdefault("embed_dim", 1024)
+    kw.setdefault("depth", 24)
+    kw.setdefault("num_heads", 16)
+    return GPT2(**kw)
+
+
+@register_model("gpt2_large")
+def gpt2_large(**kw) -> GPT2:
+    """GPT-2 774M: 36 layers, 1280 wide, 20 heads."""
+    kw.setdefault("embed_dim", 1280)
+    kw.setdefault("depth", 36)
+    kw.setdefault("num_heads", 20)
+    return GPT2(**kw)
+
+
 @register_model("gpt2_tiny")
 def gpt2_tiny(**kw) -> GPT2:
     """Small GPT-2 for tests: 2 layers, 128 wide, 1k vocab."""

@@ -126,3 +126,19 @@ def resnet50(num_classes: int = 1000, cifar_stem: bool = False,
     """ResNet-50 (ImageNet DP north-star config, BASELINE.json configs[1])."""
     return ResNet([3, 4, 6, 3], BottleneckBlock, num_classes=num_classes,
                   cifar_stem=cifar_stem, dtype=dtype)
+
+
+@register_model("resnet101")
+def resnet101(num_classes: int = 1000, cifar_stem: bool = False,
+              dtype=jnp.float32) -> ResNet:
+    """ResNet-101: the [3, 4, 23, 3] bottleneck stack."""
+    return ResNet([3, 4, 23, 3], BottleneckBlock, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)
+
+
+@register_model("resnet152")
+def resnet152(num_classes: int = 1000, cifar_stem: bool = False,
+              dtype=jnp.float32) -> ResNet:
+    """ResNet-152: the [3, 8, 36, 3] bottleneck stack."""
+    return ResNet([3, 8, 36, 3], BottleneckBlock, num_classes=num_classes,
+                  cifar_stem=cifar_stem, dtype=dtype)

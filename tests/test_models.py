@@ -171,3 +171,33 @@ def test_remat_policy_dots_matches_none():
 
     with pytest.raises(ValueError, match="remat_policy"):
         grads(remat=True, remat_policy="everything")
+
+
+def test_scaled_variant_param_counts_via_eval_shape():
+    """The scaled registry variants carry their published parameter
+    counts — checked via jax.eval_shape, which traces init without
+    allocating or computing anything, so even the 774M config costs
+    milliseconds here."""
+    def count(name, seq_input=False, **kw):
+        model = get_model(name, **kw)
+        x = (
+            jnp.zeros((1, 16), jnp.int32)
+            if seq_input else jnp.zeros((1, 224, 224, 3), jnp.float32)
+        )
+        shapes = jax.eval_shape(
+            lambda r: model.init({"params": r}, x, train=False),
+            jax.random.PRNGKey(0),
+        )
+        return sum(
+            int(np.prod(l.shape)) for l in jax.tree.leaves(shapes["params"])
+        )
+
+    # Published torchvision/HF counts (params only; BN stats excluded).
+    assert count("resnet101") == 44_549_160
+    assert count("resnet152") == 60_192_808
+    # GPT-2 355M/774M: tied-head decoder (wte+wpe+blocks+ln_f).
+    assert count("gpt2_medium", seq_input=True) == 354_823_168
+    assert count("gpt2_large", seq_input=True) == 774_030_080
+    # BERT-large encoder (+pooler +2-class head; ~335M — the published
+    # "336M" additionally counts the MLM head this classifier omits).
+    assert count("bert_large", seq_input=True) == 335_143_938
