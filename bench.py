@@ -62,8 +62,18 @@ def _init_devices_with_retry(probe_timeout=None, window_secs=None):
     init hangs.  Probes in a subprocess (killable) and KEEPS probing with
     backoff until ``window_secs`` is spent — round-3's driver run showed
     a wedged tunnel outlasting a fixed 3-attempt budget while recovering
-    minutes later, so the window (default 900s, env
-    ``BENCH_PROBE_WINDOW_SECS``) is what buys the TPU number.  The
+    minutes later.
+
+    The default window is a deliberate risk trade, not headroom
+    maximization: the driver's own kill timeout is UNKNOWN, and a run it
+    kills leaves NO record at all — strictly worse than a CPU-fallback
+    record.  Round 3 proved the driver tolerates ~12.5 min of probing
+    plus the bench itself (that fallback record landed), so the default
+    stays at 660s probing + ~2 min bench ≈ the proven total; a 900s
+    window would push ~18 min total into unproven territory where the
+    likeliest failure is losing the record entirely.  Hand-run sessions
+    (no driver timeout) should raise ``BENCH_PROBE_WINDOW_SECS`` for
+    maximum recovery odds.  The
     per-probe budget stays at 240s (env ``BENCH_PROBE_TIMEOUT_SECS``):
     a slow-but-healthy init that needs 150-240s must be able to SUCCEED
     within one probe — a shorter per-probe cap would doom every attempt
@@ -77,7 +87,7 @@ def _init_devices_with_retry(probe_timeout=None, window_secs=None):
             os.environ.get("BENCH_PROBE_TIMEOUT_SECS", "240")
         )
     if window_secs is None:
-        window_secs = float(os.environ.get("BENCH_PROBE_WINDOW_SECS", "900"))
+        window_secs = float(os.environ.get("BENCH_PROBE_WINDOW_SECS", "660"))
     deadline = time.time() + window_secs
     attempt, last = 0, ""
     while True:

@@ -128,6 +128,41 @@ def test_v3_uncommitted_checkpoint_invisible(tmp_path):
 
 
 @pytest.mark.slow
+def test_sharded_checkpoint_carries_batch_stats(tmp_path):
+    """BatchNorm state (a mutable collection, not params) must ride the
+    v3 format too: a resnet18 run checkpoints sharded and resumes with
+    its running mean/var intact — the trajectory continues exactly."""
+    from ml_trainer_tpu.models import get_model
+
+    def trainer(epochs):
+        return Trainer(
+            get_model("resnet18"),
+            datasets=(SyntheticCIFAR10(size=32, seed=0),
+                      SyntheticCIFAR10(size=16, seed=1)),
+            epochs=epochs, batch_size=16, model_dir=str(tmp_path),
+            is_parallel=True, backend="cpu", seed=3, lr=0.01,
+            optimizer="adam", metric=None, sharded_checkpoint=True,
+        )
+
+    t1 = trainer(1)
+    t1.fit()
+    latest = ckpt.latest_checkpoint(
+        os.path.join(str(tmp_path), "checkpoints")
+    )
+    assert ckpt.checkpoint_format(latest) == 3
+    t2 = trainer(2)
+    t2.fit(resume=True)
+    assert t2.train_losses[0] == pytest.approx(t1.train_losses[0], abs=1e-7)
+    # Restored batch_stats equal the saved run's, leaf for leaf.
+    restored = ckpt.restore_checkpoint(latest, t1.state)[0]
+    for a, b in zip(
+        jax.tree.leaves(t1.state.batch_stats),
+        jax.tree.leaves(restored.batch_stats),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.slow
 def test_elastic_resume_across_tensor_degrees(tmp_path):
     """The strongest re-gridding case: a checkpoint written on a
     {data:4, tensor:2} mesh resumes onto {data:2, tensor:4} — every
