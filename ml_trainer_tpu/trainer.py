@@ -1511,6 +1511,25 @@ class Trainer:
         logger.info("Saving the model.")
         ckpt.save_model_variables(model_dir, self._state_variables())
 
+    def export_torch(
+        self, path: str, ddp_prefix: bool = False, spatial_inputs=None,
+    ) -> str:
+        """Write the trained weights as a torch-loadable ``model.pth`` —
+        the migration-OUT counterpart of importing reference checkpoints
+        (checkpoint/torch_export.py inverts every layout conversion;
+        ``ddp_prefix=True`` writes the DDP ``module.``-prefixed key form).
+        ``spatial_inputs`` maps layer name -> (C, H, W) for any dense
+        layer that consumes a flattened conv output and therefore needs
+        the H·W·C -> C·H·W input un-permute (default: MLModel's ``fc1``
+        table — pass your own for other conv-to-dense models, or ``{}``
+        for models without that boundary).  With ``ema_decay`` set,
+        exports the EMA weights — the same public face ``save_model``
+        and ``test`` present."""
+        return ckpt.save_torch_checkpoint(
+            path, ckpt.fetch_to_host(self._state_variables()),
+            spatial_inputs=spatial_inputs, ddp_prefix=ddp_prefix,
+        )
+
     def save_history_(self, model_dir: str) -> None:
         """Pickle the history dict (ref: src/trainer.py:237-241) — same
         ``history.pkl`` name so ``load_history`` round-trips."""

@@ -257,3 +257,39 @@ def test_torch_export_roundtrip_and_forward_parity(tmp_path):
         np.testing.assert_array_equal(
             np.asarray(back_ddp_leaves[key]), np.asarray(orig_leaves[key])
         )
+
+
+def test_trainer_export_torch_public_api(tmp_path):
+    """Trainer.export_torch writes a .pth the import path reads back with
+    the trained values (the MIGRATION.md flow, public surface)."""
+    import pytest
+
+    pytest.importorskip("torch")
+    from ml_trainer_tpu import MLModel, Trainer
+    from ml_trainer_tpu.checkpoint import load_torch_checkpoint
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+
+    t = Trainer(
+        MLModel(),
+        datasets=(SyntheticCIFAR10(size=32, seed=0),
+                  SyntheticCIFAR10(size=16, seed=1)),
+        epochs=1, batch_size=16, model_dir=str(tmp_path), metric=None,
+        optimizer="adam", lr=0.001,
+    )
+    t.fit()
+    path = t.export_torch(str(tmp_path / "out.pth"))
+    back = load_torch_checkpoint(path)
+    # Keyed comparison (not zipped leaves): a dropped/misnamed layer must
+    # FAIL here, not silently truncate the zip.
+    def by_path(tree):
+        return {
+            jax.tree_util.keystr(p): leaf
+            for p, leaf in jax.tree_util.tree_leaves_with_path(tree)
+        }
+
+    orig, round_tripped = by_path(t.state.params), by_path(back)
+    assert orig.keys() == round_tripped.keys()
+    for key in orig:
+        np.testing.assert_array_equal(
+            np.asarray(orig[key]), np.asarray(round_tripped[key])
+        )
