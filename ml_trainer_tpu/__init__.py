@@ -27,7 +27,7 @@ from ml_trainer_tpu.models import MLModel
 from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
 from ml_trainer_tpu.generate import beam_search, generate, generate_ragged
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"  # kept in lockstep with pyproject.toml (test-pinned)
 
 __all__ = [
     "Trainer",

@@ -38,3 +38,17 @@ def test_backend_aliases_map_to_tpu_native():
     assert TrainerConfig.from_kwargs(backend="smddp").backend == "tpu"
     assert TrainerConfig.from_kwargs(backend="nccl").backend == "tpu"
     assert TrainerConfig.from_kwargs(backend="gloo").backend == "cpu"
+
+
+def test_version_matches_pyproject():
+    """__version__ and pyproject.toml must move in lockstep (they had
+    silently diverged once)."""
+    import os
+    import re
+
+    import ml_trainer_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml")) as fp:
+        m = re.search(r'^version = "([^"]+)"', fp.read(), re.M)
+    assert m and m.group(1) == ml_trainer_tpu.__version__
