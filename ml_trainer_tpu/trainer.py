@@ -45,7 +45,7 @@ from jax.sharding import PartitionSpec as P
 from tqdm import tqdm
 
 from ml_trainer_tpu import checkpoint as ckpt
-from ml_trainer_tpu.config import TrainerConfig, ALLOWED_KWARGS, validate_kwargs
+from ml_trainer_tpu.config import TrainerConfig, validate_kwargs
 from ml_trainer_tpu.data import Loader, ShardedSampler, prefetch_to_device
 from ml_trainer_tpu.models.registry import get_model
 from ml_trainer_tpu.ops import (

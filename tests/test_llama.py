@@ -123,10 +123,13 @@ def test_llama_tensor_parallel_matches_dp(tmp_path):
     np.testing.assert_allclose(dp.train_losses, tp.train_losses, rtol=1e-3)
 
 
-def test_llama_ring_sequence_parallel_matches_dp(tmp_path):
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_llama_sequence_parallel_matches_dp(tmp_path, impl):
     """llama's GQA repeats K/V to full heads before ops.attention, so
-    ring sequence parallelism composes with it unchanged: training on a
-    {data:2, sequence:4} mesh matches the pure-DP trajectory."""
+    BOTH sequence-parallel strategies compose with it unchanged:
+    training on a {data:2, sequence:4} mesh matches the pure-DP
+    trajectory (ulysses scatters the already-repeated heads — 4 heads /
+    4-way axis)."""
     from ml_trainer_tpu.parallel import create_mesh
 
     ds = SyntheticTokens(size=32, seq_len=32, vocab_size=1024, seed=2)
@@ -139,7 +142,7 @@ def test_llama_ring_sequence_parallel_matches_dp(tmp_path):
     dp.fit()
     mesh = create_mesh({"data": 2, "sequence": 4})
     sp = Trainer(
-        get_model("llama_tiny", attention_impl="ring", mesh=mesh),
+        get_model("llama_tiny", attention_impl=impl, mesh=mesh),
         model_dir=str(tmp_path / "sp"),
         mesh_shape={"data": 2, "sequence": 4}, **common,
     )
