@@ -31,6 +31,21 @@ enable_compilation_cache()
 
 BASELINE_SAMPLES_PER_SEC = 966.0  # reference train throughput, BASELINE.md
 
+# Host-wide tunnel mutex (ml_trainer_tpu/utils/tunnel.py): every tunnel
+# client on this host — this bench, scripts/bench_decode.py, the
+# watcher's probes, the recovery script's stages — serializes on one
+# flock, because concurrent dials are the leading suspect for the
+# tunnel's recurring wedge (r3/r4: hand sessions succeeded while the
+# driver's bench, racing the background watcher's probes, got nothing
+# but init hangs).
+from ml_trainer_tpu.utils.tunnel import (  # noqa: E402
+    acquire_tunnel_lock as _acquire_tunnel_lock,
+)
+
+
+def _utcnow() -> str:
+    return time.strftime("%H:%M:%S", time.gmtime()) + "Z"
+
 
 def _probe_backend_subprocess(timeout: float) -> str:
     """Try initializing the default backend in a THROWAWAY subprocess.
@@ -79,7 +94,10 @@ def _init_devices_with_retry(probe_timeout=None, window_secs=None):
     within one probe — a shorter per-probe cap would doom every attempt
     no matter how long the window.  Falls back to CPU only after the
     window, so the driver always gets a parseable JSON line.  Returns
-    (devices, note)."""
+    (devices, note, probe_log) — probe_log is the per-attempt diagnostic
+    trail (timestamp, duration, error class, lock contention) that goes
+    into the emitted record verbatim, so a failed driver run documents
+    its own failure mode instead of just "TPU unavailable"."""
     import os
 
     if probe_timeout is None:
@@ -89,12 +107,26 @@ def _init_devices_with_retry(probe_timeout=None, window_secs=None):
     if window_secs is None:
         window_secs = float(os.environ.get("BENCH_PROBE_WINDOW_SECS", "660"))
     deadline = time.time() + window_secs
+    probe_log: list = []
+    if not _acquire_tunnel_lock(deadline, probe_log):
+        jax.config.update("jax_platforms", "cpu")
+        return (
+            jax.devices(),
+            "TPU not dialed (tunnel lock held by another client for the "
+            "whole probe window); measured on CPU fallback",
+            probe_log,
+        )
     attempt, last = 0, ""
     while True:
         attempt += 1
+        t0 = time.time()
         last = _probe_backend_subprocess(probe_timeout)
+        probe_log.append(
+            {"t": _utcnow(), "attempt": attempt,
+             "secs": round(time.time() - t0, 1), "result": last or "ok"}
+        )
         if not last:
-            return jax.devices(), ""
+            return jax.devices(), "", probe_log
         print(
             f"# backend probe attempt {attempt} failed: {last} "
             f"({max(0.0, deadline - time.time()):.0f}s of window left)",
@@ -106,7 +138,11 @@ def _init_devices_with_retry(probe_timeout=None, window_secs=None):
     # Fall back to CPU in-process: safe because this process has not touched
     # the default backend yet.
     jax.config.update("jax_platforms", "cpu")
-    return jax.devices(), f"TPU unavailable ({last}); measured on CPU fallback"
+    return (
+        jax.devices(),
+        f"TPU unavailable ({last}); measured on CPU fallback",
+        probe_log,
+    )
 
 
 def _steady_state_rate(step, state, batches, warmup=5, iters=50):
@@ -527,7 +563,17 @@ def main():
         if not args.cpu and not args.assume_up:
             # Probe in a killable subprocess first: a wedged tunnel hangs
             # at backend init, which would otherwise burn the caller's
-            # full per-model timeout before it learns anything.
+            # full per-model timeout before it learns anything.  Take the
+            # host-wide tunnel lock first (held to exit) so this dial
+            # cannot race the watcher's.
+            lock_log: list = []
+            if not _acquire_tunnel_lock(time.time() + 300.0, lock_log):
+                print(json.dumps(
+                    {"model": args.one,
+                     "error": "FAILED: tunnel lock held by another client",
+                     "probe": lock_log}
+                ), flush=True)
+                sys.exit(1)
             note = _probe_backend_subprocess(timeout=240.0)
             if note:
                 print(json.dumps(
@@ -575,7 +621,9 @@ def main():
             # tunnel this flag exists to avoid.
             devices, note = jax.devices(), "CPU-pinned run (--cpu)"
         else:
-            devices, note = _init_devices_with_retry()
+            devices, note, probe_log = _init_devices_with_retry()
+            record["backend"] = "cpu" if note else "tpu"
+            record["probe"] = probe_log
         print(f"# devices: {devices}", file=sys.stderr)
         if note:
             record["note"] = note

@@ -18,7 +18,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   # here only catches a manually launched recovery — which is the point.
   # Patterns are anchored to interpreter invocations so an editor or grep
   # with one of these filenames in its argv does not park the watcher.)
-  if pgrep -f "python[0-9.]* ([^ ]*/)?(bench\.py|validate_flash_tpu\.py|mfu_ledger\.py|flash_tune\.py|make_notebooks\.py|01_local_training\.py)|bash ([^ ]*/)?(tpu_runbook\.sh|tpu_recover\.sh)$" >/dev/null 2>&1; then
+  if pgrep -f "python[0-9.]* ([^ ]*/)?(bench\.py|bench_decode\.py|validate_flash_tpu\.py|mfu_ledger\.py|flash_tune\.py|make_notebooks\.py|01_local_training\.py)|bash ([^ ]*/)?(tpu_runbook\.sh|tpu_recover\.sh)$" >/dev/null 2>&1; then
     echo "$(date -u +%H:%M:%S) busy: another TPU client running" >> "$LOG"
     sleep 300
     continue
@@ -27,9 +27,25 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     echo "$(date -u +%H:%M:%S) all artifacts present — watcher done" >> "$LOG"
     exit 0
   fi
-  if timeout 180 python -u -c "import jax; jax.devices()" >/dev/null 2>&1; then
+  # -n (not -w): if another client holds the tunnel lock, skip this
+  # cycle entirely — the watcher is the lowest-priority client and must
+  # never make a driver-launched bench.py wait on ITS probe.  rc 75
+  # (EX_TEMPFAIL) = lost the lock race, NOT a dead tunnel — logged
+  # distinctly so the log reads correctly.  The probe doubles as the
+  # keep-alive: a successful dial every cycle keeps the tunnel session
+  # warm for whichever client (e.g. the driver's bench) comes next.
+  flock -n -E 75 /tmp/tpu_tunnel.lock bash -c '
+    echo "pid=$$ tpu_watch:probe $(date -u +%H:%M:%SZ)" \
+      > /tmp/tpu_tunnel.holder
+    exec timeout 180 python -u -c "import jax; jax.devices()"' \
+    >/dev/null 2>&1
+  rc=$?
+  if [ "$rc" -eq 0 ]; then
     echo "$(date -u +%H:%M:%S) probe OK — running recovery pass" >> "$LOG"
     bash scripts/tpu_recover.sh >> "$LOG" 2>&1
+  elif [ "$rc" -eq 75 ]; then
+    echo "$(date -u +%H:%M:%S) lock busy:" \
+      "$(cat /tmp/tpu_tunnel.holder 2>/dev/null)" >> "$LOG"
   else
     echo "$(date -u +%H:%M:%S) probe failed" >> "$LOG"
   fi
