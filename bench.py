@@ -40,11 +40,8 @@ BASELINE_SAMPLES_PER_SEC = 966.0  # reference train throughput, BASELINE.md
 # but init hangs).
 from ml_trainer_tpu.utils.tunnel import (  # noqa: E402
     acquire_tunnel_lock as _acquire_tunnel_lock,
+    utcnow as _utcnow,
 )
-
-
-def _utcnow() -> str:
-    return time.strftime("%H:%M:%S", time.gmtime()) + "Z"
 
 
 def _probe_backend_subprocess(timeout: float) -> str:

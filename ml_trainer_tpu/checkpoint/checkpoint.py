@@ -320,6 +320,25 @@ def save_checkpoint_sharded(
 
     def write_files():
         os.makedirs(final_dir, exist_ok=True)
+        # A re-save of the same epoch can target a directory that already
+        # carries a committed manifest (fit() re-run without resume).
+        # np.save overwrites are not atomic, so the stale commit marker
+        # must die BEFORE the first piece file is torn open: a crash
+        # mid-save then leaves an uncommitted directory (invisible to
+        # restore) instead of a valid marker over mixed/torn pieces.
+        # The barrier keeps every other process's writes behind the
+        # unlink — manifest-last on save, manifest-first on invalidate.
+        if proc == 0:
+            try:
+                os.unlink(os.path.join(final_dir, MANIFEST))
+            except FileNotFoundError:
+                pass
+        if nproc > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"ckpt_v3_invalidate_{epoch}"
+            )
         table = []
         for leaf_id, entries in my_pieces:
             for j, starts, stops, data in entries:

@@ -87,8 +87,10 @@ class Loader:
         transform = getattr(self.dataset, "transform", None)
         rng = np.random.default_rng((self.seed, 1 + self._epoch))
         # Vectorized-gather path: ArrayDataset and the memory-mapped
-        # ShardedImageDataset both expose batch(indices).
-        fast = hasattr(self.dataset, "batch")
+        # ShardedImageDataset both expose batch(indices).  callable():
+        # a user dataset with an unrelated ``batch`` ATTRIBUTE (say an
+        # int batch size) must keep the per-item path.
+        fast = callable(getattr(self.dataset, "batch", None))
         for b in range(n_batches):
             sel = idx[b * self.batch_size : (b + 1) * self.batch_size]
             if fast:

@@ -80,6 +80,29 @@ PP_RULES = [
 ]
 
 
+def validate_tp_mesh(model, mesh) -> None:
+    """Reject meshes whose ``tensor`` degree would split attention heads.
+
+    The llama GQA rule column-shards the narrow k/v kernels
+    ([E, Hkv*D]); a tensor degree that does not divide ``num_kv_heads``
+    (e.g. tensor=8 over 4 kv heads) splits a head across shards — XLA
+    accepts the layout but the per-shard attention math is no longer
+    head-aligned.  Raise here, where the model config and the mesh first
+    meet, instead of relying on a comment (ADVICE r4)."""
+    axis = dict(zip(mesh.axis_names, mesh.devices.shape)).get("tensor", 1)
+    if axis <= 1:
+        return
+    for attr in ("num_kv_heads", "num_heads"):
+        n = getattr(model, attr, None)
+        if n is not None and n % axis:
+            raise ValueError(
+                f"mesh tensor axis ({axis}) must divide {attr} ({n}) — "
+                f"a {axis}-way split of {n} heads shards mid-head. "
+                "Use a smaller tensor degree or a model with more "
+                "(kv) heads."
+            )
+
+
 def rules_for(model_name: str, strategy: str = "tp"):
     """Pick a rule set by model family + strategy
     ('tp' | 'fsdp' | 'tp+fsdp' | 'ep' | 'pp').  EP rules ride along with

@@ -630,7 +630,12 @@ class Trainer:
         # (step counters) land on the default device and are re-placed
         # replicated so the whole state lives on the mesh.
         from ml_trainer_tpu.parallel import shard_params
+        from ml_trainer_tpu.parallel.tp_rules import validate_tp_mesh
 
+        if self._sharding_rules is not None:
+            # Fail fast on head-splitting tensor degrees (GQA: tensor
+            # must divide num_kv_heads) before any placement happens.
+            validate_tp_mesh(self.model, self.mesh)
         params = shard_params(params, self.mesh, self._sharding_rules)
         if batch_stats:
             batch_stats = shard_params(
@@ -1524,9 +1529,29 @@ class Trainer:
         table — pass your own for other conv-to-dense models, or ``{}``
         for models without that boundary).  With ``ema_decay`` set,
         exports the EMA weights — the same public face ``save_model``
-        and ``test`` present."""
+        and ``test`` present.
+
+        COLLECTIVE when params are genuinely partitioned across hosts
+        (multi-host TP/FSDP): the host fetch is then a global allgather,
+        so EVERY process must call this method (mirroring fit()'s
+        export guard) — calling it on the primary only would deadlock.
+        All hosts fetch; only the primary writes, and secondaries return
+        ``path`` without touching the filesystem."""
+        from ml_trainer_tpu.parallel.distributed import is_primary, process_count
+
+        variables = self._state_variables()
+        export_is_collective = process_count() > 1 and any(
+            not leaf.is_fully_addressable
+            and not getattr(leaf, "is_fully_replicated", False)
+            for leaf in jax.tree.leaves(variables)
+        )
+        if not is_primary() and not export_is_collective:
+            return path  # replicated params: primary-only export
+        host_vars = ckpt.fetch_to_host(variables)
+        if not is_primary():
+            return path  # joined the allgather; the primary writes
         return ckpt.save_torch_checkpoint(
-            path, ckpt.fetch_to_host(self._state_variables()),
+            path, host_vars,
             spatial_inputs=spatial_inputs, ddp_prefix=ddp_prefix,
         )
 

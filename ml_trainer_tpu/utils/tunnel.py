@@ -32,8 +32,12 @@ TUNNEL_HOLDER_PATH = "/tmp/tpu_tunnel.holder"
 _held_fd = None  # module-held so the fd lives until process exit
 
 
-def _utcnow() -> str:
+def utcnow() -> str:
+    """HH:MM:SSZ — the timestamp format of every probe_log entry."""
     return time.strftime("%H:%M:%S", time.gmtime()) + "Z"
+
+
+_utcnow = utcnow  # internal alias used below
 
 
 def read_holder() -> str:
@@ -91,4 +95,8 @@ def acquire_tunnel_lock(deadline: float, probe_log: list,
                 {"t": _utcnow(), "event": "tunnel_lock_acquired"}
             )
         _held_fd = fd
+        # Children of this process must not re-acquire on a fresh fd —
+        # flock is fd-scoped, so they would deadlock against their own
+        # parent.  Mirror the shell LOCKRUN wrapper's export.
+        os.environ["TPU_TUNNEL_LOCK_HELD"] = "1"
         return True

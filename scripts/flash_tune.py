@@ -16,6 +16,7 @@ import itertools
 import json
 import os
 import sys
+import time
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +39,11 @@ def main():
     ap.add_argument("--blocks", default="128,256,512")
     ap.add_argument("--dtype", default="bfloat16")
     args = ap.parse_args()
+    from ml_trainer_tpu.utils.tunnel import acquire_tunnel_lock
+
+    if not acquire_tunnel_lock(time.time() + 300.0, [],
+                               label="flash_tune.py"):
+        sys.exit("tunnel lock held by another client; try again later")
     assert jax.default_backend() == "tpu", (
         f"needs the chip, got {jax.default_backend()}"
     )

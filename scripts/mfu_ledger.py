@@ -41,14 +41,21 @@ HBM_PEAKS = {
 
 
 def chip_peaks():
+    """(peak FLOP/s, peak HBM B/s, matched-generation label).
+
+    The label is recorded in the ledger so an unrecognized device kind —
+    which falls back to the v5e bandwidth and can skew the mxu-vs-hbm
+    'bound' verdict — is visible in the artifact instead of silent."""
     from bench import _chip_peak_flops
 
     kind = getattr(jax.devices()[0], "device_kind", "").lower()
     gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    bw = next(
-        (v for k, v in HBM_PEAKS.items() if k in gen or k in kind), 819e9
+    matched = next(
+        (k for k in HBM_PEAKS if k in gen or k in kind), None
     )
-    return _chip_peak_flops(), bw
+    bw = HBM_PEAKS[matched] if matched else 819e9
+    label = matched or f"unknown-default-v5e (kind={kind!r}, gen={gen!r})"
+    return _chip_peak_flops(), bw, label
 
 
 def measure(model_name: str, batch: int) -> dict:
@@ -119,12 +126,13 @@ def measure(model_name: str, batch: int) -> dict:
     force(loss)
     dt = (time.perf_counter() - t0) / iters
 
-    peak_flops, peak_bw = chip_peaks()
+    peak_flops, peak_bw, hbm_generation = chip_peaks()
     achieved_flops = flops / dt if flops else None
     achieved_bw = bytes_accessed / dt if bytes_accessed else None
     row = {
         "model": model_name,
         "batch": batch,
+        "hbm_peak_generation": hbm_generation,
         "step_ms": round(dt * 1e3, 3),
         "samples_per_sec": round(batch / dt, 1),
         "flops_per_step": flops,
@@ -154,6 +162,11 @@ def main():
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--batches", default="32,128,256")
     args = ap.parse_args()
+    from ml_trainer_tpu.utils.tunnel import acquire_tunnel_lock
+
+    if not acquire_tunnel_lock(time.time() + 300.0, [],
+                               label="mfu_ledger.py"):
+        sys.exit("tunnel lock held by another client; try again later")
     assert jax.default_backend() == "tpu", (
         f"ledger needs the chip, got {jax.default_backend()}"
     )

@@ -28,7 +28,7 @@ export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 # hand-bump each round, no cross-round commingling.
 LAST_ROUND=$(ls BENCH_r*.json 2>/dev/null | sed 's/[^0-9]*//g' \
   | sort -n | tail -1)
-OUT=$(printf 'docs/bench_sessions/r%02d' $(( ${LAST_ROUND:-0} + 1 )))
+OUT=$(printf 'docs/bench_sessions/r%02d' $(( 10#${LAST_ROUND:-0} + 1 )))
 # Host-wide tunnel mutex shared with bench.py / bench_decode.py
 # (ml_trainer_tpu/utils/tunnel.py) and tpu_watch.sh: concurrent dials
 # are the leading wedge suspect.  Each stage takes it for its own

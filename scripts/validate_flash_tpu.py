@@ -53,6 +53,13 @@ def bench(fn, *args, iters=20):
 
 
 def main():
+    import time
+
+    from ml_trainer_tpu.utils.tunnel import acquire_tunnel_lock
+
+    if not acquire_tunnel_lock(time.time() + 300.0, [],
+                               label="validate_flash_tpu.py"):
+        sys.exit("tunnel lock held by another client; try again later")
     assert jax.default_backend() == "tpu", (
         f"needs the real TPU, got {jax.default_backend()}"
     )

@@ -161,3 +161,22 @@ def test_as_dataset_adapts_foreign_per_sample_transform():
     assert x.shape == (4, 32, 32, 3)  # back to NHWC float
     assert x.dtype == np.float32
     assert x.max() <= 1.0
+
+
+def test_loader_ignores_non_callable_batch_attribute():
+    """A user dataset whose ``batch`` attribute is data (say an int)
+    must take the per-item path, not the vectorized-gather fast path
+    (ADVICE r4)."""
+
+    class WithBatchField:
+        batch = 64  # unrelated to the batch(indices) protocol
+
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return np.full((2, 2, 3), i, np.float32), i % 2
+
+    loader = Loader(WithBatchField(), batch_size=4, shuffle=False)
+    xb, yb = next(iter(loader))
+    assert xb.shape == (4, 2, 2, 3) and list(yb) == [0, 1, 0, 1]

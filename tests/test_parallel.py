@@ -403,3 +403,22 @@ def test_long_context_stack_composes(tmp_path):
     np.testing.assert_allclose(
         t_ref.val_losses, t_stack.val_losses, rtol=1e-3
     )
+
+
+def test_validate_tp_mesh_rejects_head_splitting_degree():
+    """GQA guard (ADVICE r4): a tensor degree that does not divide
+    num_kv_heads must raise, not silently shard mid-head."""
+    from ml_trainer_tpu.parallel import create_mesh
+    from ml_trainer_tpu.parallel.tp_rules import validate_tp_mesh
+
+    llama = get_model("llama_tiny")  # 4 q heads / 2 kv heads
+    validate_tp_mesh(llama, create_mesh({"data": 4, "tensor": 2}))  # ok
+    with pytest.raises(ValueError, match="num_kv_heads"):
+        validate_tp_mesh(llama, create_mesh({"data": 2, "tensor": 4}))
+    # Degree must divide the q-head count too (8 > 4 heads).
+    with pytest.raises(ValueError, match="num_heads"):
+        validate_tp_mesh(
+            get_model("gpt2_tiny"), create_mesh({"tensor": 8})
+        )
+    # Meshes without a tensor axis are always fine.
+    validate_tp_mesh(llama, create_mesh({"data": 8}))

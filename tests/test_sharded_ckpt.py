@@ -127,6 +127,35 @@ def test_v3_uncommitted_checkpoint_invisible(tmp_path):
     assert latest is not None and latest.endswith("checkpoint_1")
 
 
+def test_v3_resave_crash_leaves_no_commit_marker(tmp_path, monkeypatch):
+    """Re-saving an epoch whose directory already holds a committed
+    manifest must invalidate that marker BEFORE writing pieces: a crash
+    mid-save then yields an uncommitted dir, not a valid marker over
+    torn/mixed piece files (ADVICE r4 medium)."""
+    mesh = create_mesh({"data": 8})
+    state = _mesh_state(mesh)
+    ckpt.save_checkpoint_sharded(str(tmp_path), state, {}, epoch=1)
+    path = os.path.join(str(tmp_path), "checkpoint_1")
+    assert os.path.exists(os.path.join(path, "manifest.json"))
+
+    calls = {"n": 0}
+    real_save = np.save
+
+    def crash_after_first(fname, arr, **kw):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise OSError("disk full (simulated)")
+        return real_save(fname, arr, **kw)
+
+    monkeypatch.setattr(np, "save", crash_after_first)
+    with pytest.raises(OSError):
+        ckpt.save_checkpoint_sharded(str(tmp_path), state, {}, epoch=1)
+    monkeypatch.undo()
+    # The half-written epoch is invisible — no silent corruption.
+    assert not os.path.exists(os.path.join(path, "manifest.json"))
+    assert ckpt.latest_checkpoint(str(tmp_path)) is None
+
+
 @pytest.mark.slow
 def test_sharded_checkpoint_carries_batch_stats(tmp_path):
     """BatchNorm state (a mutable collection, not params) must ride the
