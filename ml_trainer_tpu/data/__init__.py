@@ -22,7 +22,13 @@ from ml_trainer_tpu.data.text import (
     PackedLMDataset,
     TokenizedDataset,
     load_sst2_tsv,
+    pack_texts,
     tokenize_texts,
+)
+from ml_trainer_tpu.data.tokenizers import (
+    ByteLevelBPETokenizer,
+    WordPieceTokenizer,
+    load_tokenizer,
 )
 from ml_trainer_tpu.data.transforms import (
     Compose,
@@ -48,7 +54,11 @@ __all__ = [
     "PackedLMDataset",
     "TokenizedDataset",
     "load_sst2_tsv",
+    "pack_texts",
     "tokenize_texts",
+    "ByteLevelBPETokenizer",
+    "WordPieceTokenizer",
+    "load_tokenizer",
     "Compose",
     "Normalize",
     "RandomCrop",

@@ -48,12 +48,23 @@ def tokenize_texts(
     max_len: int = 128,
     tokenizer_name: Optional[str] = None,
     vocab_size: int = 30522,
+    vocab_dir: Optional[str] = None,
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Texts -> (input_ids [N, max_len], attention_mask [N, max_len]).
 
-    Uses ``transformers.AutoTokenizer`` when ``tokenizer_name`` is given and
-    loadable (local files honored; no download attempted in offline envs),
-    otherwise the hash fallback with BERT-style [CLS] ... [SEP] framing.
+    Tokenizer preference order:
+
+    1. ``transformers.AutoTokenizer`` when ``tokenizer_name`` is given
+       and loadable (local files honored; no download attempted);
+    2. the IN-TREE tokenizers (data/tokenizers.py) when ``vocab_dir`` —
+       or ``$ML_TRAINER_TPU_VOCAB_DIR``, or ``data/tokenizer/`` —
+       holds real vocab files (``vocab.json``+``merges.txt`` ->
+       byte-level BPE; ``vocab.txt`` -> WordPiece).  Token ids then come
+       from that vocab: build the model with the tokenizer's
+       ``vocab_size``, not this function's ``vocab_size`` argument;
+    3. the deterministic hash fallback (zero-egress testability),
+       bounded by ``vocab_size``, with BERT-style [CLS] ... [SEP]
+       framing.
     """
     if tokenizer_name is not None:
         try:
@@ -71,7 +82,31 @@ def tokenize_texts(
                 enc["attention_mask"].astype(np.int32),
             )
         except Exception:
-            pass  # fall through to the offline tokenizer
+            pass  # fall through to the offline tokenizers
+    from ml_trainer_tpu.data.tokenizers import (
+        encode_batch,
+        load_tokenizer,
+        resolve_vocab_dir,
+    )
+
+    vocab_dir = resolve_vocab_dir(vocab_dir)
+    tok = load_tokenizer(vocab_dir) if os.path.isdir(vocab_dir) else None
+    if tok is not None:
+        if tok.vocab_size <= vocab_size:
+            return encode_batch(tok, texts, max_len)
+        # The caller's model embeds only ``vocab_size`` rows; emitting
+        # larger ids would gather garbage SILENTLY (XLA clamps
+        # out-of-range indices).  Skip the in-tree tokenizer rather
+        # than poison training, and say why.
+        import warnings
+
+        warnings.warn(
+            f"tokenizer in {vocab_dir!r} has vocab_size "
+            f"{tok.vocab_size} > the declared embedding size "
+            f"{vocab_size}; falling back to the hash tokenizer. Build "
+            f"the model with vocab_size={tok.vocab_size} to use it.",
+            stacklevel=2,
+        )
     ids = np.zeros((len(texts), max_len), np.int32)
     mask = np.zeros((len(texts), max_len), np.int32)
     for i, text in enumerate(texts):
@@ -97,16 +132,20 @@ class TokenizedDataset(ArrayDataset):
     @classmethod
     def from_texts(cls, texts: Sequence[str], labels: Sequence[int],
                    max_len: int = 128, tokenizer_name: Optional[str] = None,
-                   vocab_size: int = 30522):
+                   vocab_size: int = 30522,
+                   vocab_dir: Optional[str] = None):
         """``vocab_size`` bounds the offline tokenizer's ids — it MUST match
         the model's embedding table (out-of-range ids gather garbage)."""
-        ids, mask = tokenize_texts(texts, max_len, tokenizer_name, vocab_size)
+        ids, mask = tokenize_texts(
+            texts, max_len, tokenizer_name, vocab_size, vocab_dir
+        )
         return cls(ids, np.asarray(labels), mask)
 
 
 def load_sst2_tsv(path: str, max_len: int = 128,
                   tokenizer_name: Optional[str] = None,
-                  vocab_size: int = 30522) -> TokenizedDataset:
+                  vocab_size: int = 30522,
+                  vocab_dir: Optional[str] = None) -> TokenizedDataset:
     """GLUE SST-2 ``train.tsv``/``dev.tsv`` (header, sentence\\tlabel)."""
     texts, labels = [], []
     with open(path) as fp:
@@ -117,8 +156,39 @@ def load_sst2_tsv(path: str, max_len: int = 128,
                 texts.append(sentence)
                 labels.append(int(label))
     return TokenizedDataset.from_texts(
-        texts, labels, max_len, tokenizer_name, vocab_size
+        texts, labels, max_len, tokenizer_name, vocab_size, vocab_dir
     )
+
+
+def pack_texts(
+    texts: Sequence[str],
+    seq_len: int = 1024,
+    vocab_dir: Optional[str] = None,
+    eos_id: Optional[int] = None,
+) -> "PackedLMDataset":
+    """Tokenize ``texts`` with the in-tree tokenizer found in
+    ``vocab_dir`` (see ``tokenize_texts`` discovery) and concatenate into
+    a :class:`PackedLMDataset` — the GPT-2 pretraining data path with
+    real tokenization.  ``eos_id`` (if given) separates documents in the
+    stream, the byte-level-BPE convention."""
+    from ml_trainer_tpu.data.tokenizers import (
+        load_tokenizer,
+        resolve_vocab_dir,
+    )
+
+    vocab_dir = resolve_vocab_dir(vocab_dir)
+    tok = load_tokenizer(vocab_dir)
+    if tok is None:
+        raise FileNotFoundError(
+            f"no tokenizer files (vocab.json+merges.txt or vocab.txt) "
+            f"in {vocab_dir!r}"
+        )
+    stream: List[int] = []
+    for text in texts:
+        stream.extend(tok.encode(text))
+        if eos_id is not None:
+            stream.append(eos_id)
+    return PackedLMDataset(np.asarray(stream, np.int32), seq_len)
 
 
 class PackedLMDataset(ArrayDataset):

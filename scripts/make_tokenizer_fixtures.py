@@ -1,0 +1,139 @@
+"""Generate the committed tokenizer fixtures
+(tests/fixtures/tokenizers/): a REAL byte-level BPE vocab trained on a
+small embedded corpus (vocab.json + merges.txt, the GPT-2 file format)
+and a WordPiece vocab.txt (BERT format).
+
+The fixtures make the tokenizer tests self-contained in this
+zero-egress environment: both file formats are exactly what the public
+pretrained tokenizers ship, so tests/test_tokenizers.py can pin parity
+between the in-tree implementations and ``transformers``' slow
+tokenizers loading the SAME files.  Deterministic: ties in the merge
+count break lexicographically.
+
+    python scripts/make_tokenizer_fixtures.py [--merges 200]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from ml_trainer_tpu.data.tokenizers import (  # noqa: E402
+    WordPieceTokenizer,
+    _byte_encoder,
+    pretokenize,
+)
+
+CORPUS = """
+The quick brown fox jumps over the lazy dog. A framework for training
+models on TPU hardware: the trainer compiles one step, shards it over a
+device mesh, and streams batches from the input pipeline. Attention is
+all you need, but bandwidth is what you pay for. Tokens in, gradients
+out; the optimizer updates the parameters and the scheduler decays the
+learning rate. It's training time: don't stop until the loss converges,
+we're watching the metrics. Checkpoints save every epoch so a failure
+costs minutes, not days. Numbers like 123 and 2026 tokenize too, as do
+symbols #@! and mixed words like bf16 and v5e. Distributed data
+parallel replicates weights; tensor parallel splits them; pipeline
+parallel stages them. The cat sat on the mat and the model sat on the
+mesh.
+"""
+
+
+def train_bpe(corpus: str, n_merges: int):
+    enc = _byte_encoder()
+    words = collections.Counter()
+    for pre in pretokenize(corpus):
+        words["".join(enc[b] for b in pre.encode("utf-8"))] += 1
+    # Every word is a tuple of current symbols; merges fuse adjacent pairs.
+    splits = {w: tuple(w) for w in words}
+    merges = []
+    for _ in range(n_merges):
+        pairs: collections.Counter = collections.Counter()
+        for w, count in words.items():
+            parts = splits[w]
+            for a, b in zip(parts, parts[1:]):
+                pairs[(a, b)] += count
+        if not pairs:
+            break
+        # max() keeps the FIRST maximum, so iterating in sorted order
+        # makes the lexicographically-smallest pair win count ties —
+        # deterministic output across runs.
+        best = max(sorted(pairs), key=lambda p: pairs[p])
+        merges.append(best)
+        fused = best[0] + best[1]
+        new_splits = {}
+        for w, parts in splits.items():
+            out = []
+            k = 0
+            while k < len(parts):
+                if k + 1 < len(parts) and (parts[k], parts[k + 1]) == best:
+                    out.append(fused)
+                    k += 2
+                else:
+                    out.append(parts[k])
+                    k += 1
+            new_splits[w] = tuple(out)
+        splits = new_splits
+    # Vocab: the 256 byte symbols in byte order, then merge products.
+    vocab = {enc[b]: b for b in range(256)}
+    vocab = {c: i for i, c in enumerate(
+        [enc[b] for b in range(256)] + [a + b for a, b in merges]
+    )}
+    return vocab, merges
+
+
+def build_wordpiece_vocab(corpus: str):
+    """Specials + every seen char (whole and ## form) + frequent whole
+    words + common suffix pieces — enough structure for greedy
+    longest-match to produce real multi-piece splits."""
+    tmp = WordPieceTokenizer({}, do_lower_case=True)
+    words = collections.Counter(tmp._basic_tokens(corpus))
+    chars = sorted({c for w in words for c in w})
+    vocab = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]"]
+    vocab += chars + ["##" + c for c in chars]
+    vocab += ["##ing", "##ed", "##er", "##es", "##s", "##ly", "##tion"]
+    # Whole words seen at least twice; the rest exercise the piecing path.
+    vocab += sorted(w for w, c in words.items() if c >= 2 and len(w) > 1)
+    seen = set()
+    uniq = [t for t in vocab if not (t in seen or seen.add(t))]
+    return uniq
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--merges", type=int, default=200)
+    ap.add_argument(
+        "--out", default=os.path.join(ROOT, "tests", "fixtures",
+                                      "tokenizers")
+    )
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    vocab, merges = train_bpe(CORPUS, args.merges)
+    with open(os.path.join(args.out, "vocab.json"), "w",
+              encoding="utf-8") as fp:
+        json.dump(vocab, fp, ensure_ascii=False)
+    with open(os.path.join(args.out, "merges.txt"), "w",
+              encoding="utf-8") as fp:
+        fp.write("#version: 0.2\n")
+        for a, b in merges:
+            fp.write(f"{a} {b}\n")
+
+    wp = build_wordpiece_vocab(CORPUS)
+    with open(os.path.join(args.out, "vocab.txt"), "w",
+              encoding="utf-8") as fp:
+        fp.write("\n".join(wp) + "\n")
+
+    print(f"BPE: {len(vocab)} tokens, {len(merges)} merges; "
+          f"WordPiece: {len(wp)} tokens -> {args.out}")
+
+
+if __name__ == "__main__":
+    main()
