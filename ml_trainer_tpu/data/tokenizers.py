@@ -111,13 +111,11 @@ def pretokenize(text: str) -> List[str]:
             while j < n and not text[j].isspace():
                 if _char_class(text[j]) != cls:
                     break
-                # A contraction boundary ends an 'O' run: "'" starts
-                # 'O', but "'s" must come out as its own token.
-                if cls == "O" and j > i and any(
-                    text.startswith(c, j) for c in _CONTRACTIONS
-                ):
-                    break
                 j += 1
+            # NOTE: contractions only win when the scan is AT the
+            # apostrophe (top of loop) — inside a symbol run the regex
+            # consumes the apostrophe into the run ("..'s" tokenizes as
+            # "..'", "s", not "..", "'s"), so no mid-run break here.
             toks.append(text[start:j])
             i = j
     return toks
@@ -163,7 +161,9 @@ class ByteLevelBPETokenizer:
 
     @property
     def vocab_size(self) -> int:
-        return len(self.vocab)
+        # max id + 1 (same bound the WordPiece property documents): the
+        # embedding-size guard needs the largest emittable id.
+        return max(self.vocab.values(), default=-1) + 1
 
     def _bpe(self, token: str) -> List[str]:
         """Merge the mapped-byte sequence of one pre-token, lowest
@@ -272,21 +272,26 @@ class WordPieceTokenizer:
 
     @property
     def vocab_size(self) -> int:
-        return len(self.vocab)
+        # max id + 1, not len(): a vocab.txt with blank lines keeps
+        # line-number ids, and the embedding-size guard in
+        # tokenize_texts must bound the LARGEST id this tokenizer can
+        # emit, not the entry count.
+        return max(self.vocab.values(), default=-1) + 1
 
     def _basic_tokens(self, text: str) -> List[str]:
         # Control chars drop; CJK chars isolate; punctuation splits.
         cleaned: List[str] = []
         for ch in text:
             cp = ord(ch)
-            # \t/\n/\r are whitespace BEFORE the control-char drop —
-            # their unicode category is Cc, but BERT keeps them as
-            # separators.
-            if ch in "\t\n\r" or ch.isspace():
+            # BERT's whitespace set is exactly " \t\n\r" + category Zs;
+            # every OTHER category-C char (\x0b, \x0c, \x85, ...) is a
+            # control char and DROPS — fusing its neighbors into one
+            # word — even though Python's isspace() says otherwise.
+            if ch in " \t\n\r" or unicodedata.category(ch) == "Zs":
                 cleaned.append(" ")
-            elif cp == 0 or cp == 0xFFFD or unicodedata.category(ch) in (
-                "Cc", "Cf"
-            ):
+            elif cp == 0 or cp == 0xFFFD or unicodedata.category(
+                ch
+            ).startswith("C"):
                 continue
             elif _is_cjk(cp):
                 cleaned.append(f" {ch} ")

@@ -82,7 +82,6 @@ def train_bpe(corpus: str, n_merges: int):
             new_splits[w] = tuple(out)
         splits = new_splits
     # Vocab: the 256 byte symbols in byte order, then merge products.
-    vocab = {enc[b]: b for b in range(256)}
     vocab = {c: i for i, c in enumerate(
         [enc[b] for b in range(256)] + [a + b for a, b in merges]
     )}
