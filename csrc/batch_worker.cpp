@@ -25,6 +25,12 @@
 #include <thread>
 #include <vector>
 
+// csrc/jpeg_decoder.cpp — the in-worker decode stage of the
+// compressed-shard path.
+extern "C" int jpeg_decode_expect(const uint8_t* buf, int64_t len,
+                                  uint8_t* out, int64_t out_cap,
+                                  int expect_w, int expect_h);
+
 namespace {
 
 struct Rng {  // xorshift64* — deterministic, cheap, per-batch seeded
@@ -61,16 +67,24 @@ class BatchWorker {
   // (ml_trainer_tpu/data/sharded.py).  The gather below gets its image
   // pointer via segment lookup, so worker threads read mapped pages
   // directly: the beyond-RAM streaming path IS the normal path.
+  // seg_offs (optional, JPEG mode): per-segment [n_s + 1] byte offsets —
+  // segment s's sample i occupies bytes [offs[i], offs[i+1]) of segs_[s],
+  // holding one baseline-JPEG stream that worker threads DECODE before
+  // the fused augmentation pass (compressed shards stay compressed on
+  // disk AND in the page cache; only the in-flight batch is ever pixels).
   BatchWorker(std::vector<const uint8_t*> segs,
               std::vector<int64_t> seg_starts, const int32_t* labels,
               int64_t n, Config cfg, int batch, int threads, int queue_cap,
-              uint64_t seed)
+              uint64_t seed,
+              std::vector<const int64_t*> seg_offs = {})
       : segs_(std::move(segs)), seg_starts_(std::move(seg_starts)),
-        labels_(labels), n_(n), cfg_(cfg), batch_(batch),
-        cap_(queue_cap), seed_(seed) {
+        seg_offs_(std::move(seg_offs)), labels_(labels), n_(n), cfg_(cfg),
+        batch_(batch), cap_(queue_cap), seed_(seed) {
     for (int t = 0; t < threads; ++t)
       team_.emplace_back([this] { Work(); });
   }
+
+  int64_t DecodeErrors() const { return decode_errors_.load(); }
 
   ~BatchWorker() {
     {
@@ -151,13 +165,32 @@ class BatchWorker {
     b.images.resize(batch_ * spp);
     b.labels.resize(batch_);
     Rng rng(seed_ ^ epoch_salt ^ (0x51ed2701ull * (batch_idx + 1)));
+    // JPEG mode: each thread reuses one decode scratch across samples.
+    thread_local std::vector<uint8_t> decoded;
     for (int i = 0; i < batch_; ++i) {
       const int64_t src = idx[i];
       // Segment holding this sample: seg_starts_ is sorted, first > src.
       const size_t seg =
           std::upper_bound(seg_starts_.begin(), seg_starts_.end(), src) -
           seg_starts_.begin() - 1;
-      const uint8_t* img = segs_[seg] + (src - seg_starts_[seg]) * spp;
+      const int64_t local = src - seg_starts_[seg];
+      const uint8_t* img;
+      if (!seg_offs_.empty()) {
+        const int64_t* offs = seg_offs_[seg];
+        decoded.resize(spp);
+        const int rc = jpeg_decode_expect(
+            segs_[seg] + offs[local], offs[local + 1] - offs[local],
+            decoded.data(), spp, w, h);
+        if (rc != 0) {
+          // A corrupt sample zeroes out rather than poisoning the whole
+          // epoch; the consumer checks DecodeErrors() and can fail loud.
+          std::memset(decoded.data(), 0, spp);
+          decode_errors_.fetch_add(1);
+        }
+        img = decoded.data();
+      } else {
+        img = segs_[seg] + local * spp;
+      }
       b.labels[i] = labels_[src];
       float* dst = b.images.data() + i * spp;
       const int oy = cfg_.pad ? static_cast<int>(rng.below(2 * cfg_.pad + 1)) : 0;
@@ -193,6 +226,8 @@ class BatchWorker {
 
   std::vector<const uint8_t*> segs_;
   std::vector<int64_t> seg_starts_;
+  std::vector<const int64_t*> seg_offs_;  // empty = raw pixels mode
+  std::atomic<int64_t> decode_errors_{0};
   const int32_t* labels_;
   int64_t n_;
   Config cfg_;
@@ -251,6 +286,33 @@ void* batch_worker_create_sharded(const uint8_t** seg_ptrs,
       make_config(height, width, channels, pad, flip, normalize, mean,
                   std_dev),
       batch, threads, queue_cap, seed);
+}
+
+// JPEG-compressed shards: segments hold concatenated baseline-JPEG byte
+// streams; seg_off_ptrs[s] is segment s's [n_s + 1] offset table.  The
+// worker threads decode each sample before the fused augmentation —
+// torch DataLoader's per-item JPEG decode, TPU-host edition.  Requires
+// channels == 3 (the decoder emits RGB; grayscale JPEGs replicate).
+void* batch_worker_create_jpeg(const uint8_t** seg_ptrs,
+                               const int64_t** seg_off_ptrs,
+                               const int64_t* seg_starts, int64_t num_segs,
+                               const int32_t* labels, int64_t n, int height,
+                               int width, int channels, int pad, int flip,
+                               int normalize, const float* mean,
+                               const float* std_dev, int batch, int threads,
+                               int queue_cap, uint64_t seed) {
+  if (channels != 3) return nullptr;
+  return new BatchWorker(
+      std::vector<const uint8_t*>(seg_ptrs, seg_ptrs + num_segs),
+      std::vector<int64_t>(seg_starts, seg_starts + num_segs), labels, n,
+      make_config(height, width, channels, pad, flip, normalize, mean,
+                  std_dev),
+      batch, threads, queue_cap, seed,
+      std::vector<const int64_t*>(seg_off_ptrs, seg_off_ptrs + num_segs));
+}
+
+int64_t batch_worker_decode_errors(void* worker) {
+  return static_cast<BatchWorker*>(worker)->DecodeErrors();
 }
 
 void batch_worker_start_epoch(void* worker, const int64_t* indices,

@@ -1,0 +1,513 @@
+// Minimal baseline JPEG decoder — the in-worker decode stage of the
+// compressed-shard input pipeline (VERDICT r4 #5: torch DataLoader
+// workers decode JPEG per item; this framework's workers previously
+// could not, forcing raw uint8 shards at ~13x the source size on disk).
+//
+// Scope: baseline sequential DCT (SOF0/SOF1), 8-bit, 1 or 3 components,
+// any sampling factors up to 4 (4:4:4 / 4:2:2 / 4:2:0 covered), restart
+// markers, FF00 byte unstuffing.  Progressive (SOF2), arithmetic coding
+// and 12-bit are rejected with a clean error — the shard INGEST encodes
+// baseline (PIL default), so the decoder only ever sees what the writer
+// produces.  Output is always interleaved RGB (grayscale replicates),
+// matching the augmentation pass's NHWC uint8 input.
+//
+// Design notes: canonical Huffman decode bit-by-bit (mincode/maxcode/
+// valptr), dequantize in zigzag order, separable float IDCT from a
+// precomputed cosine basis (accurate: differences vs libjpeg come only
+// from rounding), nearest-neighbor chroma upsampling (libjpeg's default
+// "fancy" triangular upsampling differs by a few counts on chroma
+// edges; ingest defaults to 4:4:4 where no upsampling happens at all).
+// Implemented fresh from the public JPEG (ITU-T T.81) format.
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+constexpr uint8_t kZigzag[64] = {
+    0,  1,  8,  16, 9,  2,  3,  10, 17, 24, 32, 25, 18, 11, 4,  5,
+    12, 19, 26, 33, 40, 48, 41, 34, 27, 20, 13, 6,  7,  14, 21, 28,
+    35, 42, 49, 56, 57, 50, 43, 36, 29, 22, 15, 23, 30, 37, 44, 51,
+    58, 59, 52, 45, 38, 31, 39, 46, 53, 60, 61, 54, 47, 55, 62, 63};
+
+struct HuffTable {
+  bool present = false;
+  uint8_t counts[17] = {0};  // counts[l]: codes of bit-length l (1..16)
+  int mincode[17], maxcode[17], valptr[17];
+  std::vector<uint8_t> symbols;
+
+  void Build() {
+    int code = 0, k = 0;
+    for (int l = 1; l <= 16; ++l) {
+      valptr[l] = k;
+      mincode[l] = code;
+      maxcode[l] = counts[l] ? code + counts[l] - 1 : -1;
+      code = (code + counts[l]) << 1;
+      k += counts[l];
+    }
+    present = true;
+  }
+};
+
+struct Component {
+  int id = 0, h = 1, v = 1, tq = 0, td = 0, ta = 0;
+  int dc_pred = 0;
+  int plane_w = 0, plane_h = 0;  // padded to whole blocks across MCUs
+  std::vector<uint8_t> plane;
+};
+
+// Entropy-coded-segment reader: FF00 unstuffing, stops (returning zero
+// bits) at any real marker so corrupt streams terminate instead of
+// running away.
+struct BitReader {
+  const uint8_t* p;
+  const uint8_t* end;
+  int bitpos = 0;
+  bool at_marker = false;
+
+  BitReader(const uint8_t* begin, const uint8_t* stop) : p(begin), end(stop) {}
+
+  int GetBit() {
+    if (at_marker || p >= end) return 0;
+    const int bit = (*p >> (7 - bitpos)) & 1;
+    if (++bitpos == 8) {
+      bitpos = 0;
+      if (*p == 0xFF) {
+        if (p + 1 < end && p[1] == 0x00) {
+          p += 2;  // stuffed data byte
+        } else {
+          at_marker = true;  // real marker: stop producing bits
+        }
+      } else {
+        ++p;
+      }
+    }
+    return bit;
+  }
+
+  int Receive(int n) {
+    int v = 0;
+    for (int i = 0; i < n; ++i) v = (v << 1) | GetBit();
+    return v;
+  }
+
+  // Byte-align and consume an RSTn marker.  Returns false on anything
+  // unexpected.
+  bool SkipRestart(int n) {
+    if (!at_marker && bitpos > 0) {
+      // Discard the padding bits of the partially-consumed byte (the
+      // encoder 1-pads the last entropy byte before a marker); the
+      // advance must honor FF00 stuffing like GetBit does.
+      if (*p == 0xFF) {
+        if (p + 1 < end && p[1] == 0x00) p += 2;
+      } else {
+        ++p;
+      }
+    }
+    bitpos = 0;
+    at_marker = false;
+    if (p + 1 < end && p[0] == 0xFF && p[1] == uint8_t(0xD0 + (n & 7))) {
+      p += 2;
+      return true;
+    }
+    return false;
+  }
+};
+
+struct Decoder {
+  const uint8_t* buf;
+  int64_t len;
+  int64_t pos = 0;
+
+  int width = 0, height = 0, ncomp = 0;
+  int hmax = 1, vmax = 1;
+  int restart_interval = 0;
+  uint16_t qtab[4][64] = {{0}};
+  HuffTable dc[4], ac[4];
+  Component comp[3];
+  const char* error = nullptr;
+
+  bool Fail(const char* msg) {
+    if (!error) error = msg;
+    return false;
+  }
+
+  int U8() { return pos < len ? buf[pos++] : -1; }
+  int U16() {
+    const int hi = U8(), lo = U8();
+    return (hi < 0 || lo < 0) ? -1 : (hi << 8) | lo;
+  }
+
+  bool ParseHeaders() {
+    if (U16() != 0xFFD8) return Fail("not a JPEG (no SOI)");
+    for (;;) {
+      int m = U8();
+      while (m == 0xFF) m = U8();  // fill bytes before a marker code
+      if (m < 0) return Fail("EOF before SOS");
+      const int marker = 0xFF00 | m;
+      if (marker == 0xFFD8) continue;  // stray SOI
+      const int seglen = U16();
+      if (seglen < 2 || pos + seglen - 2 > len)
+        return Fail("bad segment length");
+      const int64_t seg_end = pos + seglen - 2;
+      switch (marker) {
+        case 0xFFC0:
+        case 0xFFC1:
+          if (!ParseSOF(seg_end)) return false;
+          break;
+        case 0xFFC2:
+          return Fail("progressive JPEG unsupported (ingest writes "
+                      "baseline)");
+        case 0xFFC4:
+          if (!ParseDHT(seg_end)) return false;
+          break;
+        case 0xFFDB:
+          if (!ParseDQT(seg_end)) return false;
+          break;
+        case 0xFFDD:
+          if (seglen != 4) return Fail("bad DRI length");
+          restart_interval = U16();
+          break;
+        case 0xFFDA:
+          if (!ParseSOS(seg_end)) return false;
+          return true;  // entropy data follows; pos is at its start
+        default:
+          if (marker >= 0xFFC5 && marker <= 0xFFC7)
+            return Fail("unsupported SOF type");
+          if (marker >= 0xFFC9 && marker <= 0xFFCB)
+            return Fail("arithmetic coding unsupported");
+          pos = seg_end;  // APPn / COM / others: skip
+      }
+      if (pos != seg_end) pos = seg_end;
+    }
+  }
+
+  bool ParseSOF(int64_t seg_end) {
+    const int prec = U8();
+    if (prec != 8) return Fail("only 8-bit precision supported");
+    height = U16();
+    width = U16();
+    ncomp = U8();
+    if (height <= 0 || width <= 0) return Fail("bad dimensions");
+    if (ncomp != 1 && ncomp != 3) return Fail("only 1 or 3 components");
+    for (int i = 0; i < ncomp; ++i) {
+      comp[i].id = U8();
+      const int hv = U8();
+      comp[i].h = hv >> 4;
+      comp[i].v = hv & 15;
+      comp[i].tq = U8();
+      if (comp[i].h < 1 || comp[i].h > 4 || comp[i].v < 1 || comp[i].v > 4)
+        return Fail("bad sampling factors");
+      if (comp[i].tq > 3) return Fail("bad quant table id");
+      hmax = std::max(hmax, comp[i].h);
+      vmax = std::max(vmax, comp[i].v);
+    }
+    return pos <= seg_end || Fail("SOF overruns segment");
+  }
+
+  bool ParseDQT(int64_t seg_end) {
+    while (pos < seg_end) {
+      const int pq_tq = U8();
+      const int pq = pq_tq >> 4, tq = pq_tq & 15;
+      if (tq > 3) return Fail("bad DQT id");
+      if (pq != 0) return Fail("16-bit quant tables unsupported");
+      for (int i = 0; i < 64; ++i) qtab[tq][i] = uint16_t(U8());
+    }
+    return true;
+  }
+
+  bool ParseDHT(int64_t seg_end) {
+    while (pos < seg_end) {
+      const int tc_th = U8();
+      const int tc = tc_th >> 4, th = tc_th & 15;
+      if (tc > 1 || th > 3) return Fail("bad DHT id");
+      HuffTable& t = tc ? ac[th] : dc[th];
+      t.symbols.clear();
+      int total = 0;
+      for (int l = 1; l <= 16; ++l) {
+        t.counts[l] = uint8_t(U8());
+        total += t.counts[l];
+      }
+      if (total > 256) return Fail("bad DHT counts");
+      t.symbols.resize(total);
+      for (int i = 0; i < total; ++i) t.symbols[i] = uint8_t(U8());
+      t.Build();
+    }
+    return true;
+  }
+
+  bool ParseSOS(int64_t seg_end) {
+    const int ns = U8();
+    if (ns != ncomp) return Fail("non-interleaved scans unsupported");
+    for (int i = 0; i < ns; ++i) {
+      const int cs = U8(), tdta = U8();
+      Component* c = nullptr;
+      for (int k = 0; k < ncomp; ++k)
+        if (comp[k].id == cs) c = &comp[k];
+      if (!c) return Fail("SOS names unknown component");
+      c->td = tdta >> 4;
+      c->ta = tdta & 15;
+      if (!dc[c->td].present || !ac[c->ta].present)
+        return Fail("SOS references missing Huffman table");
+    }
+    U8();  // Ss
+    U8();  // Se
+    U8();  // Ah/Al
+    return pos <= seg_end || Fail("SOS overruns segment");
+  }
+
+  static int DecodeHuffSymbol(BitReader& br, const HuffTable& t) {
+    int code = 0;
+    for (int l = 1; l <= 16; ++l) {
+      code = (code << 1) | br.GetBit();
+      if (t.counts[l] && code <= t.maxcode[l])
+        return t.symbols[t.valptr[l] + code - t.mincode[l]];
+    }
+    return -1;
+  }
+
+  static int Extend(int v, int s) {
+    return (s && v < (1 << (s - 1))) ? v - (1 << s) + 1 : v;
+  }
+
+  // Separable float IDCT from the precomputed cosine basis: accurate to
+  // rounding, which is what the parity tests need.
+  static const float* CosBasis() {
+    static float basis[8][8];
+    static bool init = false;
+    if (!init) {
+      for (int u = 0; u < 8; ++u) {
+        const float cu = u == 0 ? float(1.0 / std::sqrt(2.0)) : 1.0f;
+        for (int x = 0; x < 8; ++x)
+          basis[u][x] = 0.5f * cu *
+                        std::cos(float((2 * x + 1) * u) * float(M_PI) / 16.0f);
+      }
+      init = true;
+    }
+    return &basis[0][0];
+  }
+
+  static void Idct8x8(const float in[64], uint8_t out[64]) {
+    const float* basis = CosBasis();  // basis[u*8 + x]
+    float tmp[64];
+    for (int y = 0; y < 8; ++y) {  // rows: sum over u
+      for (int x = 0; x < 8; ++x) {
+        float s = 0;
+        for (int u = 0; u < 8; ++u) s += basis[u * 8 + x] * in[y * 8 + u];
+        tmp[y * 8 + x] = s;
+      }
+    }
+    for (int x = 0; x < 8; ++x) {  // cols: sum over v
+      for (int y = 0; y < 8; ++y) {
+        float s = 0;
+        for (int v = 0; v < 8; ++v) s += basis[v * 8 + y] * tmp[v * 8 + x];
+        const int px = int(std::lround(s)) + 128;
+        out[y * 8 + x] = uint8_t(px < 0 ? 0 : px > 255 ? 255 : px);
+      }
+    }
+  }
+
+  bool DecodeBlock(BitReader& br, Component& c, uint8_t* dst, int stride) {
+    float block[64] = {0};
+    const uint16_t* q = qtab[c.tq];
+    const int t = DecodeHuffSymbol(br, dc[c.td]);
+    if (t < 0) return Fail("bad DC Huffman code");
+    const int diff = Extend(br.Receive(t), t);
+    c.dc_pred += diff;
+    block[0] = float(c.dc_pred) * float(q[0]);
+    for (int k = 1; k < 64;) {
+      const int rs = DecodeHuffSymbol(br, ac[c.ta]);
+      if (rs < 0) return Fail("bad AC Huffman code");
+      const int r = rs >> 4, s = rs & 15;
+      if (s == 0) {
+        if (r == 15) {
+          k += 16;  // ZRL
+          continue;
+        }
+        break;  // EOB
+      }
+      k += r;
+      if (k > 63) return Fail("AC run past block end");
+      block[kZigzag[k]] = float(Extend(br.Receive(s), s)) * float(q[k]);
+      ++k;
+    }
+    uint8_t px[64];
+    Idct8x8(block, px);
+    for (int y = 0; y < 8; ++y)
+      std::memcpy(dst + y * stride, px + y * 8, 8);
+    return true;
+  }
+
+  bool DecodeScan() {
+    const int mcux = (width + 8 * hmax - 1) / (8 * hmax);
+    const int mcuy = (height + 8 * vmax - 1) / (8 * vmax);
+    for (int i = 0; i < ncomp; ++i) {
+      comp[i].plane_w = mcux * comp[i].h * 8;
+      comp[i].plane_h = mcuy * comp[i].v * 8;
+      comp[i].plane.assign(size_t(comp[i].plane_w) * comp[i].plane_h, 0);
+      comp[i].dc_pred = 0;
+    }
+    BitReader br(buf + pos, buf + len);
+    int rst = 0, until_restart = restart_interval;
+    for (int my = 0; my < mcuy; ++my) {
+      for (int mx = 0; mx < mcux; ++mx) {
+        if (restart_interval && until_restart == 0) {
+          if (!br.SkipRestart(rst)) return Fail("missing restart marker");
+          rst = (rst + 1) & 7;
+          for (int i = 0; i < ncomp; ++i) comp[i].dc_pred = 0;
+          until_restart = restart_interval;
+        }
+        for (int i = 0; i < ncomp; ++i) {
+          Component& c = comp[i];
+          for (int by = 0; by < c.v; ++by) {
+            for (int bx = 0; bx < c.h; ++bx) {
+              uint8_t* dst = c.plane.data() +
+                             size_t(my * c.v + by) * 8 * c.plane_w +
+                             size_t(mx * c.h + bx) * 8;
+              if (!DecodeBlock(br, c, dst, c.plane_w)) return false;
+            }
+          }
+        }
+        if (restart_interval) --until_restart;
+      }
+    }
+    return true;
+  }
+
+  // Upsample one component to full [height, width] resolution.  Exact
+  // 2x ratios use the triangular (weights 3/4, 1/4) filter with the
+  // rounding offsets decoders standardized on, so 4:2:0 / 4:2:2 output
+  // matches libjpeg's default "fancy" upsampling; other ratios fall
+  // back to nearest-neighbor replication.
+  void Upsample(const Component& c, std::vector<uint8_t>& out) const {
+    const int rh = hmax / c.h, rv = vmax / c.v;
+    const int cw = (width * c.h + hmax - 1) / hmax;
+    const int ch = (height * c.v + vmax - 1) / vmax;
+    out.resize(size_t(width) * height);
+    const uint8_t* plane = c.plane.data();
+    const int stride = c.plane_w;
+    auto in = [&](int r, int x) -> int {
+      return plane[size_t(r < 0 ? 0 : r >= ch ? ch - 1 : r) * stride +
+                   (x < 0 ? 0 : x >= cw ? cw - 1 : x)];
+    };
+    if (rh == 1 && rv == 1) {
+      for (int r = 0; r < height; ++r)
+        std::memcpy(out.data() + size_t(r) * width,
+                    plane + size_t(r) * stride, width);
+      return;
+    }
+    if (rh == 2 && rv == 1) {  // h2v1 triangular per row
+      for (int r = 0; r < height; ++r) {
+        uint8_t* o = out.data() + size_t(r) * width;
+        for (int x = 0; x < cw; ++x) {
+          const int v = in(r, x) * 3;
+          const int even = x == 0 ? in(r, 0) : (v + in(r, x - 1) + 1) >> 2;
+          const int odd =
+              x == cw - 1 ? in(r, cw - 1) : (v + in(r, x + 1) + 2) >> 2;
+          if (2 * x < width) o[2 * x] = uint8_t(even);
+          if (2 * x + 1 < width) o[2 * x + 1] = uint8_t(odd);
+        }
+      }
+      return;
+    }
+    if (rh == 2 && rv == 2) {  // h2v2 triangular in both dimensions
+      for (int orow = 0; orow < height; ++orow) {
+        const int ir = orow >> 1;
+        const int near = (orow & 1) ? ir + 1 : ir - 1;
+        uint8_t* o = out.data() + size_t(orow) * width;
+        // colsum[x] = 3*cur + near, then the same 3:1 filter across x
+        // with the canonical rounding offsets (8 even, 7 odd).
+        auto colsum = [&](int x) { return in(ir, x) * 3 + in(near, x); };
+        for (int x = 0; x < cw; ++x) {
+          const int cs = colsum(x) * 3;
+          const int even = x == 0 ? (colsum(0) * 4 + 8) >> 4
+                                  : (cs + colsum(x - 1) + 8) >> 4;
+          const int odd = x == cw - 1 ? (colsum(cw - 1) * 4 + 7) >> 4
+                                      : (cs + colsum(x + 1) + 7) >> 4;
+          if (2 * x < width) o[2 * x] = uint8_t(even);
+          if (2 * x + 1 < width) o[2 * x + 1] = uint8_t(odd);
+        }
+      }
+      return;
+    }
+    for (int r = 0; r < height; ++r) {  // generic nearest
+      uint8_t* o = out.data() + size_t(r) * width;
+      const uint8_t* row = plane + size_t(r * c.v / vmax) * stride;
+      for (int x = 0; x < width; ++x) o[x] = row[x * c.h / hmax];
+    }
+  }
+
+  // Interleaved RGB out (grayscale replicated).
+  void EmitRGB(uint8_t* out) const {
+    if (ncomp == 1) {
+      const Component& y = comp[0];
+      for (int r = 0; r < height; ++r)
+        for (int cidx = 0; cidx < width; ++cidx) {
+          const uint8_t v = y.plane[size_t(r) * y.plane_w + cidx];
+          uint8_t* px = out + (size_t(r) * width + cidx) * 3;
+          px[0] = px[1] = px[2] = v;
+        }
+      return;
+    }
+    std::vector<uint8_t> yb, bb, rb;
+    Upsample(comp[0], yb);
+    Upsample(comp[1], bb);
+    Upsample(comp[2], rb);
+    for (size_t i = 0, n = size_t(width) * height; i < n; ++i) {
+      const float Y = float(yb[i]);
+      const float Cb = float(bb[i]) - 128.0f;
+      const float Cr = float(rb[i]) - 128.0f;
+      const int R = int(std::lround(Y + 1.402f * Cr));
+      const int G = int(std::lround(Y - 0.344136f * Cb - 0.714136f * Cr));
+      const int B = int(std::lround(Y + 1.772f * Cb));
+      out[i * 3 + 0] = uint8_t(R < 0 ? 0 : R > 255 ? 255 : R);
+      out[i * 3 + 1] = uint8_t(G < 0 ? 0 : G > 255 ? 255 : G);
+      out[i * 3 + 2] = uint8_t(B < 0 ? 0 : B > 255 ? 255 : B);
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Peek dimensions without decoding.  Returns 0 on success.
+int jpeg_decode_info(const uint8_t* buf, int64_t len, int* w, int* h,
+                     int* c) {
+  Decoder d{buf, len};
+  if (!d.ParseHeaders()) return -1;
+  *w = d.width;
+  *h = d.height;
+  *c = d.ncomp;
+  return 0;
+}
+
+// Decode to interleaved RGB uint8 [h, w, 3].  out_cap guards the output
+// buffer.  Returns 0 on success, negative on error.
+int jpeg_decode(const uint8_t* buf, int64_t len, uint8_t* out,
+                int64_t out_cap) {
+  Decoder d{buf, len};
+  if (!d.ParseHeaders()) return -1;
+  if (int64_t(d.width) * d.height * 3 > out_cap) return -2;
+  if (!d.DecodeScan()) return -3;
+  d.EmitRGB(out);
+  return 0;
+}
+
+// As jpeg_decode, but rejects images whose dimensions differ from the
+// expectation (-4) — the batch worker's samples are all one shape, and
+// a mismatched image must fail rather than write a misshaped buffer.
+int jpeg_decode_expect(const uint8_t* buf, int64_t len, uint8_t* out,
+                       int64_t out_cap, int expect_w, int expect_h) {
+  Decoder d{buf, len};
+  if (!d.ParseHeaders()) return -1;
+  if (d.width != expect_w || d.height != expect_h) return -4;
+  if (int64_t(d.width) * d.height * 3 > out_cap) return -2;
+  if (!d.DecodeScan()) return -3;
+  d.EmitRGB(out);
+  return 0;
+}
+
+}  // extern "C"

@@ -73,6 +73,23 @@ def load_library() -> ctypes.CDLL:
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         ]
         lib.batch_worker_destroy.argtypes = [ctypes.c_void_p]
+        lib.batch_worker_create_jpeg.restype = ctypes.c_void_p
+        lib.batch_worker_create_jpeg.argtypes = [
+            ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+        ]
+        lib.batch_worker_decode_errors.restype = ctypes.c_int64
+        lib.batch_worker_decode_errors.argtypes = [ctypes.c_void_p]
+        lib.jpeg_decode_expect.restype = ctypes.c_int
+        lib.jpeg_decode_expect.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int, ctypes.c_int,
+        ]
         _lib = lib
         return lib
 
@@ -83,6 +100,28 @@ def native_available() -> bool:
         return True
     except Exception:
         return False
+
+
+def jpeg_decode_np(data, shape) -> Optional[np.ndarray]:
+    """Decode one baseline-JPEG byte buffer to uint8 [H, W, 3] through
+    the NATIVE decoder — the same code path the C++ worker threads run,
+    so Python-side decodes are bit-equal to worker batches.  Returns
+    None when the native library is unavailable (callers fall back to
+    PIL) and raises on a corrupt stream."""
+    try:
+        lib = load_library()
+    except Exception:
+        return None
+    data = np.ascontiguousarray(np.frombuffer(bytes(data), np.uint8))
+    out = np.empty(shape, np.uint8)
+    rc = lib.jpeg_decode_expect(
+        data.ctypes.data_as(ctypes.c_void_p), len(data),
+        out.ctypes.data_as(ctypes.c_void_p), out.size,
+        int(shape[1]), int(shape[0]),
+    )
+    if rc != 0:
+        raise ValueError(f"jpeg_decode failed (rc={rc})")
+    return out
 
 
 def native_plan(dataset) -> Optional[dict]:
@@ -101,12 +140,16 @@ def native_plan(dataset) -> Optional[dict]:
         ToFloat,
     )
 
-    from ml_trainer_tpu.data.sharded import ShardedImageDataset
+    from ml_trainer_tpu.data.sharded import (
+        ShardedImageDataset,
+        ShardedJpegDataset,
+    )
 
     data = getattr(dataset, "data", None)
-    if isinstance(dataset, ShardedImageDataset):
+    if isinstance(dataset, (ShardedImageDataset, ShardedJpegDataset)):
         # Memory-mapped shards: the native worker gathers from the mapped
-        # segments directly (the beyond-RAM path).
+        # segments directly (the beyond-RAM path); jpeg shards decode on
+        # the worker threads first.
         if len(dataset.shape) != 3:
             return None
         h, w = dataset.shape[0], dataset.shape[1]
@@ -166,7 +209,10 @@ class NativeLoader:
         seed: int = 0,
         drop_last: bool = True,
     ):
-        from ml_trainer_tpu.data.sharded import ShardedImageDataset
+        from ml_trainer_tpu.data.sharded import (
+            ShardedImageDataset,
+            ShardedJpegDataset,
+        )
 
         self.dataset = dataset
         self.batch_size = int(batch_size)
@@ -175,7 +221,23 @@ class NativeLoader:
         self.seed = seed
         self.drop_last = drop_last
         self._epoch = 0
-        if isinstance(dataset, ShardedImageDataset):
+        self._jpeg = isinstance(dataset, ShardedJpegDataset)
+        if self._jpeg:
+            # Compressed path: segments are the mapped JPEG byte blobs;
+            # per-segment offset tables locate each sample's stream.
+            # Worker threads decode (csrc/jpeg_decoder.cpp) before the
+            # fused augmentation — pixels exist only for in-flight
+            # batches.
+            if len(dataset.shape) != 3 or dataset.shape[2] != 3:
+                raise ValueError("jpeg NativeLoader requires HWC RGB")
+            self._segments = list(dataset.byte_maps)
+            self._offsets = [
+                np.ascontiguousarray(o, np.int64)
+                for o in dataset.offset_tables
+            ]
+            h, w, c = dataset.shape
+            seg_starts = dataset.shard_starts[:-1]
+        elif isinstance(dataset, ShardedImageDataset):
             # Beyond-RAM path: the worker gathers straight from the
             # memory-mapped shard segments — the dataset is never copied
             # into process RAM.  (np.ascontiguousarray on a C-contiguous
@@ -209,14 +271,30 @@ class NativeLoader:
             *[s.ctypes.data for s in self._segments]
         )
         starts = (ctypes.c_int64 * n_segs)(*[int(s) for s in seg_starts])
-        self._handle = lib.batch_worker_create_sharded(
-            ctypes.cast(seg_ptrs, ctypes.POINTER(ctypes.c_void_p)),
-            ctypes.cast(starts, ctypes.POINTER(ctypes.c_int64)),
-            n_segs,
-            self._labels.ctypes.data_as(ctypes.c_void_p),
-            len(dataset), h, w, c, pad, int(flip), 1, mean, std,
-            self.batch_size, num_threads, queue_cap, seed + 1,
-        )
+        if self._jpeg:
+            off_ptrs = (ctypes.c_void_p * n_segs)(
+                *[o.ctypes.data for o in self._offsets]
+            )
+            self._handle = lib.batch_worker_create_jpeg(
+                ctypes.cast(seg_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                ctypes.cast(off_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                ctypes.cast(starts, ctypes.POINTER(ctypes.c_int64)),
+                n_segs,
+                self._labels.ctypes.data_as(ctypes.c_void_p),
+                len(dataset), h, w, c, pad, int(flip), 1, mean, std,
+                self.batch_size, num_threads, queue_cap, seed + 1,
+            )
+        else:
+            self._handle = lib.batch_worker_create_sharded(
+                ctypes.cast(seg_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                ctypes.cast(starts, ctypes.POINTER(ctypes.c_int64)),
+                n_segs,
+                self._labels.ctypes.data_as(ctypes.c_void_p),
+                len(dataset), h, w, c, pad, int(flip), 1, mean, std,
+                self.batch_size, num_threads, queue_cap, seed + 1,
+            )
+        if not self._handle:
+            raise RuntimeError("native batch worker creation failed")
 
     @property
     def sampler(self):
@@ -274,6 +352,14 @@ class NativeLoader:
             if got < 0:
                 return
             yield images, labels
+        if self._jpeg:
+            errs = self._lib.batch_worker_decode_errors(self._handle)
+            if errs:
+                # Corrupt streams were zero-filled to keep shapes; fail
+                # the epoch loudly rather than train on silent zeros.
+                raise RuntimeError(
+                    f"{errs} sample(s) failed JPEG decode this epoch"
+                )
 
     def __del__(self):
         handle = getattr(self, "_handle", None)

@@ -159,6 +159,166 @@ class ShardedImageDataset(Dataset):
         return out, self.targets[indices]
 
 
+def write_sharded_jpeg_dataset(
+    root: str,
+    samples: "Iterable[Tuple[bytes, int]]",
+    shape: Tuple[int, int, int],
+    samples_per_shard: int = 8192,
+) -> str:
+    """Write (jpeg_bytes, label) samples as COMPRESSED shards: each shard
+    is one ``.bin`` of concatenated baseline-JPEG streams plus an
+    ``[n+1]`` int64 offset table — the dataset stays at ~source size on
+    disk (raw uint8 shards cost ~13x for ImageNet-class inputs), and the
+    C++ worker decodes per sample on its threads
+    (csrc/jpeg_decoder.cpp).  ``shape`` is the (H, W, C) every stream
+    must decode to (the worker validates per image)."""
+    os.makedirs(root, exist_ok=True)
+    shards = []
+    buf: list = []
+    labels: list = []
+
+    def flush():
+        i = len(shards)
+        fj, fo = f"shard_{i:05d}_j.bin", f"shard_{i:05d}_o.npy"
+        fy = f"shard_{i:05d}_y.npy"
+        offsets = np.zeros(len(buf) + 1, np.int64)
+        np.cumsum([len(b) for b in buf], out=offsets[1:])
+        with open(os.path.join(root, fj), "wb") as fp:
+            for b in buf:
+                fp.write(b)
+        np.save(os.path.join(root, fo), offsets, allow_pickle=False)
+        np.save(os.path.join(root, fy), np.asarray(labels, np.int32),
+                allow_pickle=False)
+        shards.append({"j": fj, "o": fo, "y": fy, "n": len(buf)})
+        buf.clear()
+        labels.clear()
+
+    for data, label in samples:
+        buf.append(bytes(data))
+        labels.append(int(label))
+        if len(buf) >= samples_per_shard:
+            flush()
+    if buf:
+        flush()
+    index = {
+        "codec": "jpeg",
+        "shards": shards,
+        "shape": list(shape),
+        "total": int(sum(s["n"] for s in shards)),
+    }
+    with open(os.path.join(root, INDEX_FILE), "w") as fp:
+        json.dump(index, fp)
+    return root
+
+
+def encode_jpeg_samples(
+    batches: Iterable[Tuple[np.ndarray, np.ndarray]],
+    quality: int = 88,
+    subsampling: int = 0,
+):
+    """(images [n,H,W,C] uint8, labels) chunks -> (jpeg_bytes, label)
+    samples for ``write_sharded_jpeg_dataset``.  ``subsampling=0``
+    (4:4:4) is the default: no chroma upsampling at decode, so the
+    native decoder matches libjpeg to IDCT rounding (±3); 2 (4:2:0)
+    halves the size again and decodes through the triangular upsampler."""
+    import io
+
+    from PIL import Image
+
+    for x, y in batches:
+        x = np.asarray(x)
+        if x.dtype != np.uint8:
+            raise ValueError(f"images must be uint8, got {x.dtype}")
+        for img, label in zip(x, y):
+            buf = io.BytesIO()
+            Image.fromarray(img).save(
+                buf, "JPEG", quality=quality, subsampling=subsampling
+            )
+            yield buf.getvalue(), int(label)
+
+
+class ShardedJpegDataset(Dataset):
+    """Compressed sharded dataset (``write_sharded_jpeg_dataset``
+    layout): JPEG byte streams memory-mapped per shard, offset tables
+    and labels in RAM.
+
+    ``__getitem__``/``batch`` decode through the native decoder
+    (csrc/jpeg_decoder.cpp) so the Python path and the C++ worker
+    produce BIT-EQUAL pixels; PIL is the fallback when the native
+    library is unavailable (same images to ±3 — IDCT rounding)."""
+
+    def __init__(self, root: str, transform: Optional[Transform] = None):
+        with open(os.path.join(root, INDEX_FILE)) as fp:
+            index = json.load(fp)
+        if index.get("codec") != "jpeg":
+            raise ValueError(
+                f"{root!r} is not a jpeg-sharded dataset "
+                f"(codec={index.get('codec')!r}); use ShardedImageDataset"
+            )
+        self.root = root
+        self.transform = transform
+        self.shape = tuple(index["shape"])
+        self.total = int(index["total"])
+        self.byte_maps = [
+            np.memmap(os.path.join(root, s["j"]), np.uint8, "r")
+            for s in index["shards"]
+        ]
+        self.offset_tables = [
+            np.load(os.path.join(root, s["o"]), allow_pickle=False)
+            for s in index["shards"]
+        ]
+        for m, o, s in zip(self.byte_maps, self.offset_tables,
+                           index["shards"]):
+            if len(o) != s["n"] + 1 or o[-1] != len(m):
+                raise ValueError(f"shard {s['j']}: offset table mismatch")
+        counts = np.asarray([s["n"] for s in index["shards"]], np.int64)
+        self.shard_starts = np.concatenate([[0], np.cumsum(counts)])
+        self.targets = np.concatenate([
+            np.load(os.path.join(root, s["y"]), allow_pickle=False)
+            for s in index["shards"]
+        ]).astype(np.int32)
+        assert len(self.targets) == self.total
+
+    def __len__(self) -> int:
+        return self.total
+
+    def _decode(self, data: np.ndarray) -> np.ndarray:
+        from ml_trainer_tpu.data.native import jpeg_decode_np
+
+        out = jpeg_decode_np(data, self.shape)
+        if out is not None:
+            return out
+        import io
+
+        from PIL import Image
+
+        return np.asarray(
+            Image.open(io.BytesIO(data.tobytes())).convert("RGB")
+        )
+
+    def __getitem__(self, idx: int):
+        if idx < 0:
+            idx += self.total
+        if not 0 <= idx < self.total:
+            raise IndexError(
+                f"index {idx} out of range for dataset of {self.total}"
+            )
+        s = int(np.searchsorted(self.shard_starts, idx, "right") - 1)
+        local = idx - self.shard_starts[s]
+        o = self.offset_tables[s]
+        return (
+            self._decode(self.byte_maps[s][o[local]:o[local + 1]]),
+            self.targets[idx],
+        )
+
+    def batch(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        indices = np.asarray(indices)
+        out = np.empty((len(indices),) + self.shape, np.uint8)
+        for i, idx in enumerate(indices):
+            out[i] = self[int(idx)][0]
+        return out, self.targets[indices]
+
+
 def ingest_image_folder(
     src: str,
     dst: str,
@@ -166,15 +326,25 @@ def ingest_image_folder(
     samples_per_shard: int = 4096,
     extensions: Tuple[str, ...] = (".jpg", ".jpeg", ".png", ".bmp"),
     decode_batch: int = 256,
+    codec: str = "jpeg",
+    quality: int = 88,
+    subsampling: int = 0,
 ) -> str:
     """Decode a torchvision-``ImageFolder``-layout directory
     (``src/<class_name>/*.jpg``, classes labeled by sorted name) into the
     sharded on-disk format — the ImageNet ingestion path.
 
+    ``codec='jpeg'`` (default) re-encodes the resized images as baseline
+    JPEG into compressed shards (~source size on disk; the C++ worker
+    decodes per sample — open with ``ShardedJpegDataset``).
+    ``codec='raw'`` writes uint8 pixel shards (~13x larger for
+    ImageNet-class inputs; open with ``ShardedImageDataset``).
+
     Decoding streams: ``decode_batch`` images are decoded (PIL), resized
     to ``size`` and handed to the sharded writer at a time, so peak RAM
-    is one shard regardless of dataset size.  Returns ``dst`` (open with
-    ``ShardedImageDataset``)."""
+    is one shard regardless of dataset size.  Returns ``dst``."""
+    if codec not in ("raw", "jpeg"):
+        raise ValueError(f"codec must be 'raw' or 'jpeg', got {codec!r}")
     from PIL import Image
 
     classes = sorted(
@@ -207,7 +377,17 @@ def ingest_image_folder(
                 ys[i] = label
             yield xs, ys
 
-    write_sharded_dataset(dst, chunks(), samples_per_shard=samples_per_shard)
+    if codec == "jpeg":
+        write_sharded_jpeg_dataset(
+            dst,
+            encode_jpeg_samples(chunks(), quality, subsampling),
+            shape=size + (3,),
+            samples_per_shard=samples_per_shard,
+        )
+    else:
+        write_sharded_dataset(
+            dst, chunks(), samples_per_shard=samples_per_shard
+        )
     with open(os.path.join(dst, INDEX_FILE)) as fp:
         index = json.load(fp)
     index["classes"] = classes
