@@ -261,6 +261,140 @@ def bench_loaders(size=4096, batch_size=256, epochs=4):
         print("# input pipeline native (C++): unavailable on this host")
 
 
+def bench_serve(n_requests=32, mean_interarrival=0.01, max_batch=8,
+                seed=0):
+    """Serving leg: the continuous-batching engine vs a dynamic-batching
+    ``generate_ragged`` baseline on the SAME ragged Poisson arrival trace.
+
+    The workload is serving-shaped: ragged prompt lengths, ragged
+    per-request token budgets (most requests short, a heavy tail long —
+    the distribution that makes one-shot batching convoy), Poisson
+    arrivals (real sleeps on a compressed timescale).  The baseline is
+    the strongest server one can write on the one-shot API: harvest
+    everything queued at each completion boundary and run it through
+    ``generate_ragged`` (length buckets, pow2 batch padding) decoded to
+    the harvested batch's LARGEST budget — short requests ride out the
+    longest one (the convoy), and late arrivals wait for the whole
+    batch.  The engine admits each request into a slot at the next token
+    boundary and frees the slot the moment its budget is spent.  Both
+    paths are warmed over the workload's compile shapes first, count
+    only USEFUL tokens (each request's own budget), and are timed from
+    first submission to last completion.
+    Returns {"engine_tokens_per_sec", "baseline_tokens_per_sec", ...}.
+    """
+    import queue as _queue
+    import threading
+
+    from ml_trainer_tpu.generate import generate, generate_ragged
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import Server
+
+    model = get_model("gpt2_tiny", max_len=128)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    rng = np.random.default_rng(seed)
+    # Few distinct lengths/budgets (still ragged): keeps the baseline's
+    # (length, batch, max_new) compile space warmable so the measured
+    # gap is scheduling, not XLA compilation.
+    lengths = rng.choice([5, 9], size=n_requests)
+    budgets = rng.choice([4, 64], size=n_requests, p=[0.75, 0.25])
+    prompts = [
+        rng.integers(0, model.vocab_size, size=l).astype(np.int32)
+        for l in lengths
+    ]
+    arrivals = np.cumsum(rng.exponential(mean_interarrival, n_requests))
+    total_tokens = int(budgets.sum())  # useful tokens, both paths
+
+    def run_engine():
+        with Server(model, variables, max_batch=max_batch,
+                    max_queue=n_requests) as srv:
+            # Warm the engine's compiled programs (prefill buckets +
+            # decode step) outside the timed window.
+            for l in sorted(set(int(x) for x in lengths)):
+                srv.complete(prompts[list(lengths).index(l)], 2,
+                             timeout=300)
+            t0 = time.perf_counter()
+            streams = []
+            for i, p in enumerate(prompts):
+                wait = arrivals[i] - (time.perf_counter() - t0)
+                if wait > 0:
+                    time.sleep(wait)
+                streams.append(srv.submit(p, int(budgets[i])))
+            lat = []
+            for i, s in enumerate(streams):
+                s.result(timeout=600)
+                lat.append(
+                    s.request.finished_at - s.request.submitted_at
+                )
+            elapsed = time.perf_counter() - t0
+        return total_tokens / elapsed, float(np.median(lat))
+
+    def run_baseline():
+        # Warm every (length, pow2-batch<=max_batch, batch-max-budget)
+        # program the harvest loop can hit.
+        for l in sorted(set(int(x) for x in lengths)):
+            p = prompts[list(lengths).index(l)]
+            for m in sorted(set(int(x) for x in budgets)):
+                b = 1
+                while b <= max_batch:
+                    generate(model, variables, np.stack([p] * b), m)
+                    b *= 2
+        pending: _queue.Queue = _queue.Queue()
+
+        def feeder():
+            t0 = time.perf_counter()
+            for i, p in enumerate(prompts):
+                wait = arrivals[i] - (time.perf_counter() - t0)
+                if wait > 0:
+                    time.sleep(wait)
+                pending.put((i, p, time.perf_counter()))
+
+        th = threading.Thread(target=feeder)
+        t0 = time.perf_counter()
+        th.start()
+        done, lat = 0, []
+        while done < n_requests:
+            batch = [pending.get()]
+            while len(batch) < max_batch:
+                try:
+                    batch.append(pending.get_nowait())
+                except _queue.Empty:
+                    break
+            # One-shot API: the whole batch decodes to its largest
+            # budget (per-request early exit is exactly what the API
+            # cannot do); surplus tokens are discarded, not counted.
+            horizon = max(int(budgets[i]) for i, _, _ in batch)
+            generate_ragged(
+                model, variables, [p for _, p, _ in batch], horizon
+            )
+            now = time.perf_counter()
+            lat.extend(now - t_in for _, _, t_in in batch)
+            done += len(batch)
+        elapsed = time.perf_counter() - t0
+        th.join()
+        return total_tokens / elapsed, float(np.median(lat))
+
+    base_tps, base_lat = run_baseline()
+    print(f"# serve baseline (generate_ragged): {base_tps:,.1f} tokens/s, "
+          f"p50 latency {base_lat * 1e3:,.0f} ms", flush=True)
+    eng_tps, eng_lat = run_engine()
+    print(f"# serve engine (continuous batching): {eng_tps:,.1f} tokens/s, "
+          f"p50 latency {eng_lat * 1e3:,.0f} ms "
+          f"({eng_tps / base_tps:.2f}x baseline)", flush=True)
+    return {
+        "engine_tokens_per_sec": round(eng_tps, 1),
+        "baseline_tokens_per_sec": round(base_tps, 1),
+        "engine_p50_latency_ms": round(eng_lat * 1e3, 1),
+        "baseline_p50_latency_ms": round(base_lat * 1e3, 1),
+        "speedup": round(eng_tps / base_tps, 2),
+        "n_requests": n_requests,
+        "useful_tokens": total_tokens,
+        "backend": jax.default_backend(),
+    }
+
+
 def _chip_peak_flops() -> float:
     """Peak bf16 FLOPs/s of one chip of the local TPU generation.
 
@@ -538,6 +672,11 @@ def main():
     parser.add_argument("--loaders", action="store_true",
                         help="run only the host input-pipeline benchmark "
                         "(Python vs C++ loader; no device work)")
+    parser.add_argument("--serve", action="store_true",
+                        help="run only the serving benchmark: the "
+                        "continuous-batching engine vs a generate_ragged "
+                        "dynamic-batching baseline on ragged Poisson "
+                        "arrivals (gpt2_tiny; CPU-safe)")
     parser.add_argument("--assume-up", action="store_true",
                         help="skip the --one pre-probe (used by --extended, "
                         "whose parent just probed — a second throwaway "
@@ -584,6 +723,11 @@ def main():
         # Host-side only: measures the input pipeline, touches no device,
         # so it is safe (and meaningful) while the TPU tunnel is down.
         bench_loaders()
+        return
+    if args.serve:
+        # Tiny model; meaningful on any backend.  One JSON line for the
+        # driver, engine-vs-baseline, like the headline metric.
+        print(json.dumps({"serve": bench_serve()}))
         return
     record = {
         "metric": (

@@ -14,9 +14,10 @@
 // Design notes: canonical Huffman decode bit-by-bit (mincode/maxcode/
 // valptr), dequantize in zigzag order, separable float IDCT from a
 // precomputed cosine basis (accurate: differences vs libjpeg come only
-// from rounding), nearest-neighbor chroma upsampling (libjpeg's default
-// "fancy" triangular upsampling differs by a few counts on chroma
-// edges; ingest defaults to 4:4:4 where no upsampling happens at all).
+// from rounding), libjpeg-style triangular ("fancy") chroma upsampling
+// for the 2x ratios (4:2:2 / 4:2:0), nearest-neighbor only as the
+// generic fallback for other factors; ingest defaults to 4:4:4 where no
+// upsampling happens at all.
 // Implemented fresh from the public JPEG (ITU-T T.81) format.
 
 #include <cmath>

@@ -27,15 +27,18 @@ _lib = None
 _lib_lock = threading.Lock()
 
 
+_SOURCES = ("batch_worker.cpp", "jpeg_decoder.cpp")
+
+
 def _build_library() -> str:
-    src = os.path.join(_CSRC, "batch_worker.cpp")
+    srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
     # Compile to a private temp path, then atomically publish: concurrent
     # processes (parallel pytest, multi-process workers) may rebuild at
     # the same time, and one must never dlopen a half-written .so.
     tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     subprocess.run(
         ["g++", "-O3", "-std=c++17", "-fPIC", "-pthread", "-Wall", "-shared",
-         "-o", tmp, src],
+         "-o", tmp, *srcs],
         check=True,
         capture_output=True,
     )
@@ -48,10 +51,11 @@ def load_library() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        src = os.path.join(_CSRC, "batch_worker.cpp")
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.exists(src)
-            and os.path.getmtime(src) > os.path.getmtime(_LIB_PATH)
+        srcs = [os.path.join(_CSRC, s) for s in _SOURCES]
+        if not os.path.exists(_LIB_PATH) or any(
+            os.path.exists(s)
+            and os.path.getmtime(s) > os.path.getmtime(_LIB_PATH)
+            for s in srcs
         ):
             _build_library()
         lib = ctypes.CDLL(_LIB_PATH)
@@ -222,6 +226,12 @@ class NativeLoader:
         self.drop_last = drop_last
         self._epoch = 0
         self._jpeg = isinstance(dataset, ShardedJpegDataset)
+        # Decode-error accounting baseline: the C++ counter is CUMULATIVE
+        # across epochs, so every check compares against this snapshot
+        # (taken at each epoch start) rather than the raw value —
+        # otherwise an early ``break`` defers one epoch's corrupt samples
+        # into a later epoch's raise.
+        self._err_base = 0
         if self._jpeg:
             # Compressed path: segments are the mapped JPEG byte blobs;
             # per-segment offset tables locate each sample's stream.
@@ -334,6 +344,13 @@ class NativeLoader:
             # repeats leading samples instead of being short.
             idx = np.resize(idx, need)
         idx = np.ascontiguousarray(idx[:need], np.int64)
+        if self._jpeg:
+            # Re-baseline BEFORE the epoch runs: errors left unobserved by
+            # a prior epoch's early break belong to that epoch, not this
+            # one (stop()/__del__ surface them instead).
+            self._err_base = self._lib.batch_worker_decode_errors(
+                self._handle
+            )
         self._lib.batch_worker_start_epoch(
             self._handle,
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -352,17 +369,51 @@ class NativeLoader:
             if got < 0:
                 return
             yield images, labels
-        if self._jpeg:
-            errs = self._lib.batch_worker_decode_errors(self._handle)
-            if errs:
-                # Corrupt streams were zero-filled to keep shapes; fail
-                # the epoch loudly rather than train on silent zeros.
-                raise RuntimeError(
-                    f"{errs} sample(s) failed JPEG decode this epoch"
-                )
+        errs = self._decode_error_delta()
+        if errs:
+            # Corrupt streams were zero-filled to keep shapes; fail
+            # the epoch loudly rather than train on silent zeros.
+            raise RuntimeError(
+                f"{errs} sample(s) failed JPEG decode this epoch"
+            )
+
+    def _decode_error_delta(self) -> int:
+        """New decode errors since the last check (delta against the
+        cumulative C++ counter; consumes what it reports)."""
+        if not self._jpeg or not getattr(self, "_handle", None):
+            return 0
+        errs = int(self._lib.batch_worker_decode_errors(self._handle))
+        delta = errs - self._err_base
+        self._err_base = errs
+        return delta
+
+    def stop(self) -> None:
+        """Tear down the C++ worker now (idempotent).  Raises if decode
+        errors accumulated since the last check — a consumer that broke
+        out of an epoch early still hears about its corrupt samples."""
+        handle = getattr(self, "_handle", None)
+        if not handle:
+            return
+        errs = self._decode_error_delta()
+        self._lib.batch_worker_destroy(handle)
+        self._handle = None
+        if errs:
+            raise RuntimeError(
+                f"{errs} sample(s) failed JPEG decode since the last check"
+            )
 
     def __del__(self):
         handle = getattr(self, "_handle", None)
         if handle:
+            errs = self._decode_error_delta()
             self._lib.batch_worker_destroy(handle)
             self._handle = None
+            if errs:
+                # Raising in __del__ is unraisable noise; warn instead so
+                # the corruption is at least visible.
+                import warnings
+
+                warnings.warn(
+                    f"NativeLoader destroyed with {errs} unreported JPEG "
+                    "decode error(s)"
+                )

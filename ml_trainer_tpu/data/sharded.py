@@ -103,6 +103,12 @@ class ShardedImageDataset(Dataset):
     def __init__(self, root: str, transform: Optional[Transform] = None):
         with open(os.path.join(root, INDEX_FILE)) as fp:
             index = json.load(fp)
+        codec = index.get("codec", "raw")
+        if codec != "raw":
+            raise ValueError(
+                f"{root!r} holds {codec!r}-codec shards, not raw uint8 "
+                "pixel shards; open it with ShardedJpegDataset"
+            )
         self.root = root
         self.transform = transform
         self.shape = tuple(index["shape"])
@@ -326,7 +332,7 @@ def ingest_image_folder(
     samples_per_shard: int = 4096,
     extensions: Tuple[str, ...] = (".jpg", ".jpeg", ".png", ".bmp"),
     decode_batch: int = 256,
-    codec: str = "jpeg",
+    codec: str = "raw",
     quality: int = 88,
     subsampling: int = 0,
 ) -> str:
@@ -334,11 +340,12 @@ def ingest_image_folder(
     (``src/<class_name>/*.jpg``, classes labeled by sorted name) into the
     sharded on-disk format — the ImageNet ingestion path.
 
-    ``codec='jpeg'`` (default) re-encodes the resized images as baseline
-    JPEG into compressed shards (~source size on disk; the C++ worker
-    decodes per sample — open with ``ShardedJpegDataset``).
-    ``codec='raw'`` writes uint8 pixel shards (~13x larger for
-    ImageNet-class inputs; open with ``ShardedImageDataset``).
+    ``codec='raw'`` (default — the original on-disk format; existing
+    callers keep opening results with ``ShardedImageDataset``) writes
+    uint8 pixel shards (~13x larger than source for ImageNet-class
+    inputs).  ``codec='jpeg'`` opts into compressed shards: the resized
+    images re-encode as baseline JPEG (~source size on disk; the C++
+    worker decodes per sample — open with ``ShardedJpegDataset``).
 
     Decoding streams: ``decode_batch`` images are decoded (PIL), resized
     to ``size`` and handed to the sharded writer at a time, so peak RAM

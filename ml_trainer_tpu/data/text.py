@@ -35,6 +35,10 @@ def _stable_hash(word: str) -> int:
     )
 
 
+# One-shot flag for the implicit data/tokenizer/ discovery warning.
+_warned_implicit_vocab = False
+
+
 def _hash_tokenize(text: str, vocab_size: int) -> List[int]:
     """Deterministic fallback tokenizer (whitespace + stable hash)."""
     return [
@@ -89,8 +93,27 @@ def tokenize_texts(
         resolve_vocab_dir,
     )
 
+    implicit = vocab_dir is None and not os.environ.get(
+        "ML_TRAINER_TPU_VOCAB_DIR"
+    )
     vocab_dir = resolve_vocab_dir(vocab_dir)
     tok = load_tokenizer(vocab_dir) if os.path.isdir(vocab_dir) else None
+    if tok is not None and implicit:
+        # The mere presence of a CWD-relative data/tokenizer/ changes
+        # token ids for callers that never asked for it; say so ONCE per
+        # process so the switch is visible, not silent.
+        global _warned_implicit_vocab
+        if not _warned_implicit_vocab:
+            _warned_implicit_vocab = True
+            import warnings
+
+            warnings.warn(
+                f"tokenize_texts discovered a vocab in {vocab_dir!r} "
+                "(CWD-relative default) and will use it instead of the "
+                "hash fallback; pass vocab_dir=... or set "
+                "ML_TRAINER_TPU_VOCAB_DIR to make this explicit",
+                stacklevel=2,
+            )
     if tok is not None:
         if tok.vocab_size <= vocab_size:
             return encode_batch(tok, texts, max_len)

@@ -20,12 +20,21 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ml_trainer_tpu.utils.utils import LRUCache
+
 # Compiled decode programs keyed by (module, batch, prompt_len,
 # max_new_tokens, dtype, greedy, top_k, top_p, eos_token_id,
 # pad_token_id) — flax modules are frozen dataclasses, hence hashable
 # keys.  The filter/stop values are static (each compiles its own
-# program); temperature is traced (does not).
-_COMPILED: dict = {}
+# program); temperature is traced (does not).  Bounded: every entry pins
+# an XLA executable, and a long-lived process seeing many shapes would
+# otherwise grow without limit.  The serving engine's bucketed prefill
+# programs (serving/engine.py) share this cache under their own key
+# prefix, so one knob bounds every compiled decode program in the
+# process (env ``ML_TRAINER_TPU_COMPILE_CACHE``).
+_COMPILED: LRUCache = LRUCache(
+    int(__import__("os").environ.get("ML_TRAINER_TPU_COMPILE_CACHE", "128"))
+)
 
 
 def generate(

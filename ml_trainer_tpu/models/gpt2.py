@@ -36,6 +36,15 @@ def _embed_input(mdl: nn.Module, input_ids, pos_start=None):
     )
     if pos_start is None:
         pos_slice = pos[:, :s]
+    elif getattr(pos_start, "ndim", 0) == 1:
+        # Per-row positions (serving slots: each batch row decodes at its
+        # own sequence position) — only the single-token step applies.
+        if s != 1:
+            raise ValueError(
+                "per-row pos_start (serving slots) supports only "
+                f"single-token decode steps, got seq len {s}"
+            )
+        pos_slice = jnp.take(pos[0], pos_start, axis=0)[:, None, :]
     else:
         pos_slice = _jax.lax.dynamic_slice(
             pos, (0, pos_start, 0), (1, s, mdl.embed_dim)

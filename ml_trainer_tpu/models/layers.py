@@ -104,6 +104,40 @@ class MultiHeadAttention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         idx = idx_var.value
+        if idx.ndim == 1:
+            # Slot-indexed serving mode (serving/engine.py): ``cache_index``
+            # is a PER-ROW [B] vector — each batch row (slot) sits at its
+            # own sequence position, so rows write K/V at their own index
+            # and attend their own valid prefix.  Only the single-token
+            # decode step supports this; prefill runs per request at
+            # batch 1 with the ordinary scalar index and is inserted into
+            # the slot cache afterwards.
+            if s != 1:
+                raise ValueError(
+                    "per-row cache_index (serving slots) supports only "
+                    f"single-token decode steps, got seq len {s}"
+                )
+
+            def write_row(cache_row, kv_row, i):
+                # [H, L, D] <- [H, 1, D] at position i of THIS row only.
+                return jax.lax.dynamic_update_slice(
+                    cache_row, kv_row, (0, i, 0)
+                )
+
+            cached_k.value = jax.vmap(write_row)(
+                cached_k.value, k.astype(self.dtype), idx
+            )
+            cached_v.value = jax.vmap(write_row)(
+                cached_v.value, v.astype(self.dtype), idx
+            )
+            idx_var.value = idx + s
+            valid = (
+                jnp.arange(L)[None, :] <= idx[:, None]
+            )[:, None, None, :]
+            return attention(
+                q, cached_k.value, cached_v.value,
+                causal=False, mask=valid, implementation="xla",
+            )
         cached_k.value = jax.lax.dynamic_update_slice(
             cached_k.value, k.astype(self.dtype), (0, 0, idx, 0)
         )

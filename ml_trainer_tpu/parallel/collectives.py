@@ -16,6 +16,8 @@ from typing import Union, Sequence
 import jax
 from jax import lax
 
+from ml_trainer_tpu.parallel.compat import axis_size as _axis_size
+
 AxisName = Union[str, Sequence[str]]
 
 
@@ -43,7 +45,7 @@ def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
 def ppermute_ring(x, axis: AxisName, shift: int = 1):
     """Send each shard to its ring neighbour over ICI — the building block
     of ring attention (parallel/ring.py rotates K/V through it)."""
-    n = lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return lax.ppermute(x, axis, perm)
 
@@ -61,4 +63,4 @@ def axis_index(axis: AxisName):
 
 
 def axis_size(axis: AxisName):
-    return lax.axis_size(axis)
+    return _axis_size(axis)

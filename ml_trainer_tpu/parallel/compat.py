@@ -1,0 +1,53 @@
+"""jax version compat shims for the parallel package.
+
+Three drifts between jax 0.4.x and newer jax broke this repo:
+
+* ``shard_map`` moved from ``jax.experimental.shard_map`` to the
+  top-level ``jax`` namespace;
+* its replication-check kwarg was renamed ``check_rep`` → ``check_vma``;
+* ``lax.axis_size`` (the named-axis size inside shard_map/pmap bodies)
+  does not exist on 0.4.x — ``psum(1, axis)`` is the portable spelling.
+
+Everything in this repo imports ``shard_map`` from here, written against
+the NEW spelling (``check_vma=``); on an old jax the wrapper maps the
+kwarg back down.
+
+    from ml_trainer_tpu.parallel.compat import shard_map
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+
+try:  # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map  # type: ignore[attr-defined]
+except ImportError:  # jax 0.4.x: experimental home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_PARAMS = frozenset(inspect.signature(_shard_map).parameters)
+
+
+@functools.wraps(_shard_map)
+def shard_map(*args, **kwargs):
+    if "check_vma" in kwargs and "check_vma" not in _PARAMS:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    elif "check_rep" in kwargs and "check_rep" not in _PARAMS:
+        kwargs["check_vma"] = kwargs.pop("check_rep")
+    return _shard_map(*args, **kwargs)
+
+
+if hasattr(__import__("jax").lax, "axis_size"):
+    from jax.lax import axis_size
+else:
+    def axis_size(axis_name) -> int:
+        """Size of a named mesh axis from inside a shard_map/pmap body.
+        jax 0.4.x fallback: ``psum`` of a literal constant-folds to a
+        plain Python int, so callers can keep building static artifacts
+        (permutation lists, loop bounds) from it."""
+        from jax import lax
+
+        return lax.psum(1, axis_name)
+
+
+__all__ = ["shard_map", "axis_size"]

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ml_trainer_tpu.parallel.compat import axis_size, shard_map
 
 
 def stack_stage_params(per_stage_params: list) -> Any:
@@ -38,7 +38,7 @@ def _pipeline_local(params, x, *, stage_fn, axis_name, n_micro, remat):
     params: this device's stage params (leading stage dim of size 1).
     x: the full [n_micro, mb, ...] microbatched input (replicated).
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     params = jax.tree.map(lambda p: p[0], params)  # drop the stage dim
     mb_shape = x.shape[1:]

@@ -24,7 +24,7 @@ from jax import lax
 
 from ml_trainer_tpu.parallel.collectives import ppermute_ring
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from ml_trainer_tpu.parallel.compat import axis_size, shard_map
 
 
 def _block_attend(q, k, v, m_prev, l_prev, o_prev, q_offset, k_offset,
@@ -53,7 +53,7 @@ def _block_attend(q, k, v, m_prev, l_prev, o_prev, q_offset, k_offset,
 
 def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
     """Runs per-shard inside shard_map.  q/k/v: [B, H, S_local, D]."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     s_local = q.shape[-2]
     q32 = q.astype(jnp.float32)

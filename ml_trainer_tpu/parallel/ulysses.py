@@ -28,7 +28,7 @@ import jax
 
 from ml_trainer_tpu.parallel.collectives import all_to_all
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from ml_trainer_tpu.parallel.compat import shard_map
 
 
 def _ulysses_local(q, k, v, *, axis_name, causal, scale, attend):

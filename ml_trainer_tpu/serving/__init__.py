@@ -1,0 +1,33 @@
+"""Continuous-batching serving engine (docs/serving.md).
+
+One preallocated slot cache, one compiled per-token decode step;
+requests join and leave at token boundaries with no recompilation.
+
+    from ml_trainer_tpu.serving import Server
+
+    server = Server(model, variables, max_batch=8)
+    stream = server.submit(prompt_ids, max_new_tokens=64)
+    for token in stream: ...          # streamed
+    full = server.complete(prompt_ids, 64)   # blocking
+"""
+
+from ml_trainer_tpu.serving.api import Server, TokenStream
+from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+from ml_trainer_tpu.serving.metrics import ServingMetrics
+from ml_trainer_tpu.serving.scheduler import (
+    AdmissionError,
+    DeadlineExceeded,
+    FifoScheduler,
+    Request,
+)
+
+__all__ = [
+    "Server",
+    "TokenStream",
+    "SlotDecodeEngine",
+    "ServingMetrics",
+    "FifoScheduler",
+    "Request",
+    "AdmissionError",
+    "DeadlineExceeded",
+]

@@ -1,0 +1,287 @@
+"""Thread-safe serving front end over the slot decode engine.
+
+``Server`` owns the engine, the FIFO scheduler, and one worker thread
+driving the admit/step loop.  Callers interact through:
+
+* ``submit(prompt, max_new_tokens, ...) -> TokenStream`` — non-blocking;
+  the stream iterates tokens as they decode and ``result()`` blocks for
+  the full sequence (prompt + continuation, ``generate()``'s layout);
+* ``complete(...)`` — the blocking convenience wrapper;
+* ``serve_http(port=...)`` — an OPTIONAL stdlib HTTP front end
+  (``http.server``; no dependencies), started only when asked for
+  (constructor flag ``http_port`` or an explicit call): POST
+  ``/v1/generate`` with ``{"prompt": [ids...], "max_new_tokens": n,
+  "temperature": t?, "seed": s?, "eos_token_id": e?, "deadline": d?}``
+  returns ``{"tokens": [...]}``; GET ``/metrics`` returns the serving
+  metrics snapshot; GET ``/healthz`` liveness.  Backpressure maps to
+  HTTP 429, deadlines to 504.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+
+from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+from ml_trainer_tpu.serving.metrics import ServingMetrics
+from ml_trainer_tpu.serving.scheduler import (
+    AdmissionError,
+    DeadlineExceeded,
+    FifoScheduler,
+    Request,
+    _DONE,
+)
+from ml_trainer_tpu.utils.logging import get_logger
+
+
+class TokenStream:
+    """Streaming view of one request: iterate tokens as they arrive, or
+    ``result()`` for the whole sequence."""
+
+    def __init__(self, req: Request, prompt: np.ndarray):
+        self._req = req
+        self._prompt = prompt
+        self._drained = False
+
+    @property
+    def request(self) -> Request:
+        return self._req
+
+    def __iter__(self):
+        while True:
+            item = self._req._stream.get()
+            if item == _DONE:
+                self._drained = True
+                self._raise_on_failure()
+                return
+            yield item
+
+    def _raise_on_failure(self):
+        if self._req.state == "expired":
+            raise DeadlineExceeded(self._req.error or "deadline exceeded")
+        if self._req.state == "error":
+            raise RuntimeError(self._req.error or "serving engine error")
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until the request finishes; returns
+        ``[prompt + new tokens]`` (1-D int32).  Raises
+        ``DeadlineExceeded`` / ``RuntimeError`` on failure states."""
+        if not self._drained:
+            while True:
+                item = self._req._stream.get(timeout=timeout)
+                if item == _DONE:
+                    self._drained = True
+                    break
+        self._raise_on_failure()
+        return np.concatenate(
+            [self._prompt, np.asarray(self._req.tokens, np.int32)]
+        )
+
+    @property
+    def tokens(self) -> list:
+        """Tokens decoded so far (no blocking)."""
+        return list(self._req.tokens)
+
+
+class Server:
+    """Continuous-batching serving session: engine + scheduler + one
+    worker thread.  Use as a context manager in tests/scripts so the
+    thread is joined deterministically."""
+
+    def __init__(self, model, variables: dict, max_batch: int = 8,
+                 max_queue: int = 64,
+                 metrics: Optional[ServingMetrics] = None,
+                 idle_poll: float = 0.02,
+                 http_port: Optional[int] = None):
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.engine = SlotDecodeEngine(
+            model, variables, max_batch=max_batch, metrics=self.metrics
+        )
+        self.scheduler = FifoScheduler(
+            max_batch, max_queue=max_queue, metrics=self.metrics
+        )
+        self._idle_poll = idle_poll
+        self._log = get_logger("ml_trainer_tpu.serving")
+        self._wake = threading.Event()
+        self._stopping = False
+        self._httpd = None
+        self._http_thread = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="serving-engine"
+        )
+        self._thread.start()
+        if http_port is not None:
+            self.serve_http(port=http_port)
+
+    # -- client surface --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, rng=None,
+               eos_token_id: Optional[int] = None,
+               deadline: Optional[float] = None) -> TokenStream:
+        """Enqueue one request (thread-safe).  Raises ``AdmissionError``
+        when the queue is at its watermark and ``ValueError`` on a
+        request the engine could never serve."""
+        if self._stopping:
+            raise RuntimeError("server is closed")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens > self.engine.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + new tokens ({max_new_tokens}) "
+                f"exceeds the model's max_len ({self.engine.max_len})"
+            )
+        if eos_token_id is not None and not (
+            0 <= eos_token_id < self.engine.vocab_size
+        ):
+            raise ValueError(
+                f"eos_token_id must be in [0, {self.engine.vocab_size}), "
+                f"got {eos_token_id}"
+            )
+        req = Request(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), rng=rng,
+            eos_token_id=eos_token_id, deadline=deadline,
+        )
+        self.scheduler.submit(req)
+        self._wake.set()
+        return TokenStream(req, prompt)
+
+    def complete(self, prompt, max_new_tokens: int,
+                 timeout: Optional[float] = None, **kwargs) -> np.ndarray:
+        """Blocking one-shot: submit and wait for the full sequence."""
+        return self.submit(prompt, max_new_tokens, **kwargs).result(
+            timeout=timeout
+        )
+
+    def close(self) -> None:
+        self._stopping = True
+        self._wake.set()
+        self._thread.join(timeout=10.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- engine loop -----------------------------------------------------
+
+    def _loop(self) -> None:
+        engine, sched = self.engine, self.scheduler
+        while not self._stopping:
+            try:
+                progressed = False
+                while engine.free_capacity() > 0:
+                    got = sched.acquire()
+                    if got is None:
+                        break
+                    req, slot = got
+                    if not engine.admit(req, slot):
+                        sched.release(slot)
+                    progressed = True
+                if engine.active_count():
+                    for slot in engine.step():
+                        sched.release(slot)
+                    progressed = True
+                if not progressed:
+                    self._wake.wait(timeout=self._idle_poll)
+                    self._wake.clear()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                # Fail every in-flight request loudly rather than hang
+                # their streams, then keep serving new ones.
+                self._log.error(
+                    "serving_engine_error", error=f"{type(e).__name__}: {e}"
+                )
+                for slot, req in list(engine._active.items()):
+                    req.finish("error", f"{type(e).__name__}: {e}")
+                    del engine._active[slot]
+                    sched.release(slot)
+        # Shutdown: fail whatever is still in flight or queued so no
+        # caller blocks forever on a stream the engine will never feed.
+        for slot, req in list(engine._active.items()):
+            req.finish("error", "server closed")
+            del engine._active[slot]
+            sched.release(slot)
+        while True:
+            got = sched.acquire()
+            if got is None:
+                break
+            req, slot = got
+            req.finish("error", "server closed")
+            sched.release(slot)
+
+    # -- HTTP front end --------------------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the stdlib HTTP front end (daemon thread); returns the
+        bound ``(host, port)``.  Explicitly opt-in — nothing listens
+        unless this is called (or ``http_port`` was passed)."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: we have metrics
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._send(200, {"ok": True})
+                elif self.path == "/metrics":
+                    self._send(200, server.metrics.snapshot())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    out = server.complete(
+                        np.asarray(body["prompt"], np.int32),
+                        int(body.get("max_new_tokens", 16)),
+                        temperature=float(body.get("temperature", 0.0)),
+                        rng=body.get("seed"),
+                        eos_token_id=body.get("eos_token_id"),
+                        deadline=body.get("deadline"),
+                    )
+                    self._send(200, {"tokens": [int(t) for t in out]})
+                except AdmissionError as e:
+                    self._send(429, {"error": str(e)})
+                except DeadlineExceeded as e:
+                    self._send(504, {"error": str(e)})
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="serving-http",
+        )
+        self._http_thread.start()
+        return self._httpd.server_address

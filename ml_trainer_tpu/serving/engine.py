@@ -1,0 +1,290 @@
+"""Slot-based continuous-batching decode engine.
+
+``generate()`` is one-shot: a whole batch prefills together, decodes in
+lockstep, and every row waits for the slowest (the convoy effect); a new
+batch shape means a new compile.  This engine serves requests that
+arrive at arbitrary times through ONE preallocated KV-cache block and
+ONE compiled per-token decode program:
+
+* **Slots.**  The cache is the flax ``decode``-mode cache built at batch
+  ``max_batch`` — per attention layer ``[max_batch, H, max_len, D]`` —
+  with the scalar ``cache_index``/``pos_index`` leaves widened to
+  per-row ``[max_batch]`` vectors (models/layers.py's slot-indexed
+  path), so every row sits at its OWN sequence position.  A request owns
+  one row (slot) for its lifetime.
+
+* **Prefill.**  A new request prefills OUT OF BAND at batch 1: its
+  prompt is right-padded to the next power-of-two bucket (at most
+  log2(max_len) compiled prefill programs — ``generate_ragged``'s
+  bucketing trick applied to length instead of batch), one batched
+  causal forward fills a fresh batch-1 cache, the true-length logits
+  sample token 0, and the rows are inserted into the slot cache with the
+  index vectors set to the TRUE prompt length.  Padding garbage beyond
+  the true length is never attended: the decode mask is
+  ``arange(max_len) <= index[slot]`` and later tokens overwrite it.
+
+* **Decode.**  All slots advance through a single compiled step —
+  ``[max_batch, 1]`` tokens in, one forward, per-row sampling out.
+  Requests join (prefill + insert) and leave (EOS / budget / deadline)
+  at token boundaries with NO recompilation: shapes are static, inactive
+  slots just compute masked garbage that nobody reads.
+
+Sampling matches ``generate()`` token-for-token per request: greedy is
+``argmax``; ``temperature > 0`` draws
+``categorical(fold_in(rng, t), logits / temperature)`` with the
+request's own rng and per-token counter ``t`` — byte-identical to a
+standalone batch-1 ``generate()`` call for the same request.
+
+Compiled programs (prefill buckets, the decode step, the slot insert)
+live in the process-wide LRU shared with ``generate._COMPILED``, so one
+bound covers every decode executable in the process.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, _empty_cache
+from ml_trainer_tpu.serving.metrics import ServingMetrics
+from ml_trainer_tpu.serving.scheduler import Request
+
+
+def _as_key(rng) -> np.ndarray:
+    """Normalize a request rng (None | int seed | PRNG key) to raw
+    uint32[2] key data.  None matches ``generate()``'s PRNGKey(0)
+    default so an rng-less sampled request reproduces the rng-less
+    ``generate()`` call."""
+    if rng is None:
+        rng = 0
+    if isinstance(rng, (int, np.integer)):
+        rng = jax.random.PRNGKey(int(rng))
+    key = np.asarray(rng, np.uint32).reshape(-1)
+    if key.shape != (2,):
+        raise ValueError(f"rng must be an int seed or a PRNG key, got {rng!r}")
+    return key
+
+
+def _sample_rows(last, temps, rngs, steps):
+    """Per-row sampling: greedy argmax where ``temps == 0``, else
+    ``categorical(fold_in(rng_row, t_row), last_row / temp_row)`` — the
+    same draw ``generate()`` makes for that request at token ``t``."""
+    greedy_tok = jnp.argmax(last, axis=-1)
+    keys = jax.vmap(jax.random.fold_in)(rngs, steps)
+    safe = jnp.where(temps > 0, temps, 1.0)[:, None]
+    sampled = jax.vmap(jax.random.categorical)(keys, last / safe)
+    return jnp.where(temps > 0, sampled, greedy_tok)
+
+
+class SlotDecodeEngine:
+    """The slot cache plus its three compiled programs.  Single-threaded
+    by design: one worker (serving/api.py's loop) calls ``admit`` and
+    ``step``; thread-safe admission lives in the scheduler."""
+
+    def __init__(self, model, variables: dict, max_batch: int = 8,
+                 metrics: Optional[ServingMetrics] = None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if not getattr(model, "max_len", 0):
+            raise ValueError(
+                "serving needs a causal LM exposing decode/max_len "
+                f"(got {type(model).__name__})"
+            )
+        self.model = model
+        self.dm = model.clone(decode=True)
+        self.params = (
+            variables["params"] if "params" in variables else variables
+        )
+        self.max_batch = max_batch
+        self.max_len = int(model.max_len)
+        self.vocab_size = int(model.vocab_size)
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+
+        # Batch-1 cache shapes for prefill; slot cache at max_batch with
+        # the scalar index leaves widened to [max_batch] vectors.
+        self._shapes_b1 = _cache_shapes(self.dm, 1, jnp.int32)
+        shapes_mb = _cache_shapes(self.dm, max_batch, jnp.int32)
+        self.cache = jax.tree.map(
+            lambda s: jnp.zeros(
+                (max_batch,) if s.ndim == 0 else s.shape, s.dtype
+            ),
+            shapes_mb,
+        )
+        self.tok = jnp.zeros((max_batch, 1), jnp.int32)
+        self._temps = np.zeros((max_batch,), np.float32)
+        self._rngs = np.zeros((max_batch, 2), np.uint32)
+        self._steps = np.zeros((max_batch,), np.int32)
+        self._active: Dict[int, Request] = {}
+
+        self._decode = self._program(
+            ("serve_decode", model, max_batch), self._build_decode
+        )
+        self._insert = self._program(
+            ("serve_insert", model, max_batch), self._build_insert
+        )
+
+    # -- compiled programs ----------------------------------------------
+
+    def _program(self, key, build):
+        run = _COMPILED.get(key)
+        if run is None:
+            run = build()
+            _COMPILED[key] = run
+        return run
+
+    def _build_decode(self):
+        dm = self.dm
+
+        def step(params, cache, tok, temps, rngs, steps):
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                train=False, mutable=["cache"],
+            )
+            nxt = _sample_rows(logits[:, -1], temps, rngs, steps)
+            return mut["cache"], nxt[:, None].astype(jnp.int32)
+
+        return jax.jit(step, donate_argnums=(1, 2))
+
+    def _build_insert(self):
+        def insert(cache_big, tok_big, cache1, tok0, slot, true_len):
+            def leaf(big, small):
+                if big.ndim == small.ndim:
+                    # K/V row replace: [1, H, L, D] into row ``slot``.
+                    start = (slot,) + (0,) * (big.ndim - 1)
+                    return jax.lax.dynamic_update_slice(
+                        big, small.astype(big.dtype), start
+                    )
+                # Index vector vs the prefill's scalar: the slot's
+                # position is the TRUE prompt length, not the padded
+                # bucket the scalar advanced to.
+                return big.at[slot].set(jnp.asarray(true_len, big.dtype))
+
+            cache_big = jax.tree.map(leaf, cache_big, cache1)
+            tok_big = jax.lax.dynamic_update_slice(
+                tok_big, tok0[:, None], (slot, 0)
+            )
+            return cache_big, tok_big
+
+        return jax.jit(insert, donate_argnums=(0, 1))
+
+    def _build_prefill(self, bucket: int):
+        dm = self.dm
+        shapes = self._shapes_b1
+
+        def prefill(params, prompt_pad, true_len, temp, rng):
+            cache = _empty_cache(shapes)
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache}, prompt_pad,
+                train=False, mutable=["cache"],
+            )
+            # Causal prefill: the padded tail cannot influence position
+            # true_len-1, whose logits sample token 0 (fold counter 0 —
+            # generate()'s t=0 draw).
+            last = jax.lax.dynamic_index_in_dim(
+                logits, true_len - 1, axis=1, keepdims=False
+            )
+            tok = _sample_rows(
+                last, temp[None], rng[None], jnp.zeros((1,), jnp.int32)
+            )
+            return mut["cache"], tok.astype(jnp.int32)
+
+        return jax.jit(prefill)
+
+    # -- serving ---------------------------------------------------------
+
+    def free_capacity(self) -> int:
+        return self.max_batch - len(self._active)
+
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def admit(self, req: Request, slot: int) -> bool:
+        """Prefill ``req`` into ``slot`` and emit its first token.
+        Returns False when the request finished immediately (EOS on
+        token 0, or a one-token budget) — the caller recycles the slot."""
+        if slot in self._active:
+            raise ValueError(f"slot {slot} is already occupied")
+        req.slot = slot
+        req.state = "active"
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        p = prompt.shape[0]
+        bucket = min(1 << (p - 1).bit_length(), self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = prompt
+        key = _as_key(req.rng)
+        run = self._program(
+            ("serve_prefill", self.model, bucket),
+            lambda: self._build_prefill(bucket),
+        )
+        t0 = time.perf_counter()
+        cache1, tok0 = run(
+            self.params, padded, np.int32(p),
+            jnp.asarray(req.temperature, jnp.float32), key,
+        )
+        self.cache, self.tok = self._insert(
+            self.cache, self.tok, cache1, tok0, np.int32(slot), np.int32(p)
+        )
+        tok0 = np.asarray(tok0)  # blocks until prefill + insert land
+        self.metrics.record_prefill(time.perf_counter() - t0)
+        self._temps[slot] = req.temperature
+        self._rngs[slot] = key
+        self._steps[slot] = 1
+        token = int(tok0[0])
+        req.push_token(token)
+        self.metrics.record_ttft(time.monotonic() - req.submitted_at)
+        self._active[slot] = req
+        if self._finished(req, token):
+            return False
+        return True
+
+    def _finished(self, req: Request, token: int) -> bool:
+        """Finish-and-unbind if ``req`` just completed; True if so."""
+        done = (
+            req.eos_token_id is not None and token == req.eos_token_id
+        ) or len(req.tokens) >= req.max_new_tokens
+        if done:
+            req.finish("done")
+            self.metrics.record_completion()
+            del self._active[req.slot]
+        return done
+
+    def step(self) -> List[int]:
+        """One compiled decode step over all slots; distributes each
+        active slot's token and returns the slots freed this step."""
+        if not self._active:
+            return []
+        active_before = len(self._active)
+        t0 = time.perf_counter()
+        self.cache, self.tok = self._decode(
+            self.params, self.cache, self.tok,
+            self._temps, self._rngs, self._steps,
+        )
+        toks = np.asarray(self.tok[:, 0])  # blocks until the step lands
+        dt = time.perf_counter() - t0
+        freed: List[int] = []
+        emitted = 0
+        now = time.monotonic()
+        for slot in sorted(self._active):
+            req = self._active[slot]
+            if req.expired(now):
+                req.finish(
+                    "expired",
+                    f"deadline ({req.deadline}s) passed mid-decode "
+                    f"after {len(req.tokens)} token(s)",
+                )
+                self.metrics.record_expiry()
+                del self._active[slot]
+                freed.append(slot)
+                continue
+            self._steps[slot] += 1
+            token = int(toks[slot])
+            req.push_token(token)
+            emitted += 1
+            if self._finished(req, token):
+                freed.append(slot)
+        self.metrics.record_step(dt, active_before, self.max_batch, emitted)
+        return freed

@@ -1,0 +1,163 @@
+"""Serving metrics: what a dashboard needs to judge a decode engine.
+
+Tracked per engine instance, aggregated in-process (no external metrics
+dependency — the container is zero-egress):
+
+* **time-to-first-token** (TTFT): submit -> first token available, the
+  user-facing latency of admission + queueing + prefill;
+* **per-step decode latency**: one compiled decode step over all active
+  slots, the engine's heartbeat;
+* **tokens/s**: decoded tokens over busy time (sum of step latencies) and
+  over wall time since the first step — busy excludes idle waits, wall
+  matches what a load test observes;
+* **queue depth** and **slot occupancy**: where the backpressure story
+  lives (scheduler watermark / convoy detection).
+
+Exported through ``utils/logging.py``: ``ServingMetrics.log()`` emits one
+structured ``serving_metrics`` event with the snapshot as key-values, so
+the serving process logs in the same shape as the trainer.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+
+def _percentile(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * (len(sorted_vals) - 1) + 0.5))
+    return float(sorted_vals[i])
+
+
+class ServingMetrics:
+    """Thread-safe rolling serving metrics (bounded windows)."""
+
+    def __init__(self, window: int = 2048):
+        self._lock = threading.Lock()
+        self._ttft = collections.deque(maxlen=window)
+        self._prefill_secs = collections.deque(maxlen=window)
+        self._step_secs = collections.deque(maxlen=window)
+        self._occupancy = collections.deque(maxlen=window)
+        self.tokens_total = 0
+        self.steps_total = 0
+        self.busy_secs = 0.0
+        self.requests_admitted = 0
+        self.requests_rejected = 0
+        self.requests_completed = 0
+        self.requests_expired = 0
+        self.max_active_slots = 0
+        self.queue_depth = 0
+        self._first_step_at: Optional[float] = None
+        self._last_step_at: Optional[float] = None
+
+    # -- recording -------------------------------------------------------
+
+    def record_ttft(self, seconds: float) -> None:
+        with self._lock:
+            self._ttft.append(float(seconds))
+
+    def record_prefill(self, seconds: float, tokens: int = 1) -> None:
+        """One out-of-band prefill: its latency counts as busy time and
+        it emits the request's first token."""
+        with self._lock:
+            self._prefill_secs.append(float(seconds))
+            self.busy_secs += float(seconds)
+            self.tokens_total += int(tokens)
+
+    def record_step(self, seconds: float, active_slots: int,
+                    total_slots: int, tokens: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._step_secs.append(float(seconds))
+            self._occupancy.append(
+                active_slots / total_slots if total_slots else 0.0
+            )
+            self.busy_secs += float(seconds)
+            self.tokens_total += int(tokens)
+            self.steps_total += 1
+            self.max_active_slots = max(self.max_active_slots, active_slots)
+            if self._first_step_at is None:
+                self._first_step_at = now - seconds
+            self._last_step_at = now
+
+    def record_admission(self, queue_depth: int) -> None:
+        with self._lock:
+            self.requests_admitted += 1
+            self.queue_depth = int(queue_depth)
+
+    def record_rejection(self) -> None:
+        with self._lock:
+            self.requests_rejected += 1
+
+    def record_completion(self) -> None:
+        with self._lock:
+            self.requests_completed += 1
+
+    def record_expiry(self) -> None:
+        with self._lock:
+            self.requests_expired += 1
+
+    def record_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = int(depth)
+
+    # -- reading ---------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """One flat dict of the current aggregates (JSON-safe floats)."""
+        with self._lock:
+            ttft = sorted(self._ttft)
+            steps = sorted(self._step_secs)
+            wall = (
+                self._last_step_at - self._first_step_at
+                if self._first_step_at is not None
+                and self._last_step_at is not None
+                and self._last_step_at > self._first_step_at
+                else 0.0
+            )
+            occ = (
+                sum(self._occupancy) / len(self._occupancy)
+                if self._occupancy else 0.0
+            )
+            return {
+                "ttft_p50_ms": round(_percentile(ttft, 0.5) * 1e3, 3),
+                "ttft_p99_ms": round(_percentile(ttft, 0.99) * 1e3, 3),
+                "decode_step_p50_ms": round(
+                    _percentile(steps, 0.5) * 1e3, 3
+                ),
+                "decode_step_p99_ms": round(
+                    _percentile(steps, 0.99) * 1e3, 3
+                ),
+                "prefill_p50_ms": round(
+                    _percentile(sorted(self._prefill_secs), 0.5) * 1e3, 3
+                ),
+                "tokens_total": self.tokens_total,
+                "decode_steps_total": self.steps_total,
+                "tokens_per_sec_busy": round(
+                    self.tokens_total / self.busy_secs, 1
+                ) if self.busy_secs > 0 else 0.0,
+                "tokens_per_sec_wall": round(
+                    self.tokens_total / wall, 1
+                ) if wall > 0 else 0.0,
+                "slot_occupancy_mean": round(occ, 4),
+                "max_active_slots": self.max_active_slots,
+                "queue_depth": self.queue_depth,
+                "requests_admitted": self.requests_admitted,
+                "requests_rejected": self.requests_rejected,
+                "requests_completed": self.requests_completed,
+                "requests_expired": self.requests_expired,
+            }
+
+    def log(self, logger=None) -> dict:
+        """Emit the snapshot as one structured log event (and return it)."""
+        if logger is None:
+            from ml_trainer_tpu.utils.logging import get_logger
+
+            logger = get_logger("ml_trainer_tpu.serving")
+        snap = self.snapshot()
+        logger.info("serving_metrics", **snap)
+        return snap

@@ -1,0 +1,168 @@
+"""Admission control and slot bookkeeping for the decode engine.
+
+FIFO with backpressure: a bounded pending queue admits requests in
+arrival order; past the watermark ``submit`` raises ``AdmissionError``
+immediately (reject-with-error beats unbounded queues — the caller can
+shed load or retry with jitter, and the engine's memory stays bounded by
+``max_queue + max_batch`` requests).  Per-request deadlines are enforced
+at every hand-off point: a queued request whose deadline passes is
+expired instead of admitted, and the engine expires active requests
+between decode steps.  Slots (rows of the engine's preallocated cache
+block) recycle the moment a request finishes — EOS, token budget, or
+deadline — so the next queued request joins the running batch at a token
+boundary.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import queue as _queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+
+class AdmissionError(RuntimeError):
+    """The pending queue is at its watermark; the request was rejected."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before it finished."""
+
+
+_ids = itertools.count()
+
+# Stream sentinels (queue items are plain ints otherwise).
+_DONE = ("done", None)
+
+
+@dataclass
+class Request:
+    """One generation request moving through queue -> slot -> done.
+
+    ``deadline`` is a relative budget in seconds from submission (wall
+    budget, checked with ``time.monotonic``).  ``rng`` seeds sampling for
+    ``temperature > 0`` — an int seed or a jax PRNG key; the engine folds
+    the per-token counter exactly like ``generate()`` does, so a request
+    at seed ``s`` reproduces ``generate(..., rng=jax.random.PRNGKey(s))``
+    token-for-token."""
+
+    prompt: np.ndarray
+    max_new_tokens: int
+    temperature: float = 0.0
+    rng: object = None
+    eos_token_id: Optional[int] = None
+    deadline: Optional[float] = None
+
+    id: int = field(default_factory=lambda: next(_ids))
+    submitted_at: float = field(default_factory=time.monotonic)
+    state: str = "queued"  # queued | active | done | expired | error
+    slot: int = -1
+    step: int = 0          # tokens sampled so far (the fold_in counter)
+    tokens: list = field(default_factory=list)
+    error: Optional[str] = None
+    first_token_at: Optional[float] = None
+    finished_at: Optional[float] = None
+    _stream: _queue.Queue = field(default_factory=_queue.Queue)
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return self.submitted_at + self.deadline
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        d = self.deadline_at
+        return d is not None and (now or time.monotonic()) > d
+
+    # -- engine-side hand-off -------------------------------------------
+
+    def push_token(self, token: int) -> None:
+        self.tokens.append(int(token))
+        if self.first_token_at is None:
+            self.first_token_at = time.monotonic()
+        self._stream.put(int(token))
+
+    def finish(self, state: str = "done", error: Optional[str] = None) -> None:
+        self.state = state
+        self.error = error
+        self.finished_at = time.monotonic()
+        self._stream.put(_DONE)
+
+
+class FifoScheduler:
+    """Bounded FIFO admission + free-slot pool (thread-safe)."""
+
+    def __init__(self, max_batch: int, max_queue: int = 64,
+                 metrics=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {max_queue}")
+        self.max_queue = max_queue
+        self._lock = threading.Lock()
+        self._pending: collections.deque = collections.deque()
+        self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0 first
+        self._metrics = metrics
+
+    # -- producer side ---------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        """Enqueue or raise ``AdmissionError`` past the watermark."""
+        with self._lock:
+            if len(self._pending) >= self.max_queue:
+                if self._metrics is not None:
+                    self._metrics.record_rejection()
+                raise AdmissionError(
+                    f"pending queue at watermark ({self.max_queue}); "
+                    f"request {req.id} rejected"
+                )
+            self._pending.append(req)
+            if self._metrics is not None:
+                self._metrics.record_admission(len(self._pending))
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- engine side -----------------------------------------------------
+
+    def acquire(self) -> Optional[tuple]:
+        """Next admissible (request, slot) pair, or None.
+
+        Skips (and expires) queued requests whose deadline already
+        passed — they would only waste a prefill.  Returns None when no
+        slot is free or the queue is empty."""
+        with self._lock:
+            while self._pending and self._free_slots:
+                req = self._pending.popleft()
+                if self._metrics is not None:
+                    self._metrics.record_queue_depth(len(self._pending))
+                if req.expired():
+                    req.finish(
+                        "expired",
+                        f"deadline ({req.deadline}s) passed while queued",
+                    )
+                    if self._metrics is not None:
+                        self._metrics.record_expiry()
+                    continue
+                req.slot = self._free_slots.pop()
+                req.state = "active"
+                return req, req.slot
+            return None
+
+    def release(self, slot: int) -> None:
+        """Return a slot to the pool (request finished — EOS, budget,
+        deadline, or error)."""
+        with self._lock:
+            if slot in self._free_slots:
+                raise ValueError(f"slot {slot} is already free")
+            self._free_slots.append(slot)
+
+    def free_slot_count(self) -> int:
+        with self._lock:
+            return len(self._free_slots)

@@ -18,6 +18,48 @@ import numpy as np
 from ml_trainer_tpu.checkpoint import load_model_variables, load_torch_checkpoint
 
 
+class LRUCache:
+    """Bounded mapping with least-recently-used eviction.
+
+    Caps the compiled-program caches (``generate._COMPILED``, shared with
+    the serving engine's prefill programs): every distinct decode shape
+    keeps an XLA executable alive, and a long-lived serving process that
+    sees many shapes would otherwise grow without bound.  ``get`` and
+    ``__setitem__`` both refresh recency.  Not thread-safe by itself;
+    callers that mutate from several threads hold their own lock (the
+    serving engine admits from a single worker thread)."""
+
+    def __init__(self, maxsize: int = 64):
+        import collections
+
+        if maxsize < 1:
+            raise ValueError(f"maxsize must be >= 1, got {maxsize}")
+        self.maxsize = maxsize
+        self._data = collections.OrderedDict()
+
+    def get(self, key, default=None):
+        try:
+            self._data.move_to_end(key)
+        except KeyError:
+            return default
+        return self._data[key]
+
+    def __setitem__(self, key, value) -> None:
+        self._data[key] = value
+        self._data.move_to_end(key)
+        while len(self._data) > self.maxsize:
+            self._data.popitem(last=False)
+
+    def __contains__(self, key) -> bool:
+        return key in self._data
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+    def clear(self) -> None:
+        self._data.clear()
+
+
 def load_history(file_dir: str) -> dict:
     """Unpickle ``history.pkl`` from a directory (ref: src/utils/utils.py:9-12)."""
     path = os.path.join(file_dir, "history.pkl")

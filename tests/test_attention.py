@@ -234,9 +234,9 @@ def test_flash_inside_shard_map_matches_dense():
     TPU; rehearse the composition on the CPU mesh (interpret-mode kernel
     under shard_map over the sequence axis after an all-to-all)."""
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
 
     from ml_trainer_tpu.parallel import create_mesh
+    from ml_trainer_tpu.parallel.compat import shard_map
 
     mesh = create_mesh({"sequence": 4}, devices=jax.devices()[:4])
     q, k, v = qkv(b=2, h=4, s=256, d=64, seed=7)

@@ -1,0 +1,175 @@
+"""Compressed (JPEG) shard pipeline: round trip and decoder parity.
+
+The ADVICE-requested coverage for csrc/jpeg_decoder.cpp and its Python
+surface: ``write_sharded_jpeg_dataset`` -> ``ShardedJpegDataset`` ->
+``NativeLoader`` must hand back the SAME pixels the Python decode path
+produces (both run csrc/jpeg_decoder.cpp — bit-equal), the native
+decoder must match PIL/libjpeg to IDCT rounding (±3) including the
+4:2:0 triangular-upsampling path, and corrupt streams must be reported
+per epoch, not deferred into a later one.
+"""
+
+import io
+
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.data.native import (
+    NativeLoader,
+    jpeg_decode_np,
+    native_available,
+)
+from ml_trainer_tpu.data.sharded import (
+    ShardedImageDataset,
+    ShardedJpegDataset,
+    encode_jpeg_samples,
+    write_sharded_jpeg_dataset,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++ / native library unavailable"
+)
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def _images(n, h=32, w=32, seed=0):
+    """Structured uint8 RGB images (gradients + texture + noise) — JPEG
+    behaves realistically on these, unlike pure uniform noise."""
+    rng = np.random.default_rng(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    base = np.stack([
+        xx * 255.0 / w,
+        yy * 255.0 / h,
+        128 + 96 * np.sin(xx / 5.0) * np.cos(yy / 7.0),
+    ], -1)
+    out = np.empty((n, h, w, 3), np.uint8)
+    for i in range(n):
+        out[i] = np.clip(
+            np.roll(base, i * 3, axis=1) + rng.normal(0, 10, base.shape),
+            0, 255,
+        ).astype(np.uint8)
+    return out
+
+
+def _write(tmp_path, images, labels, subsampling=0, **kw):
+    return write_sharded_jpeg_dataset(
+        str(tmp_path / "jds"),
+        encode_jpeg_samples(
+            [(images, labels)], quality=88, subsampling=subsampling
+        ),
+        shape=images.shape[1:],
+        **kw,
+    )
+
+
+def test_roundtrip_write_then_native_loader(tmp_path):
+    """write_sharded_jpeg_dataset -> NativeLoader round trip: the C++
+    worker's decoded pixels are bit-equal to the Python decode path
+    (ShardedJpegDataset.batch), labels ride along, order preserved."""
+    images = _images(40)
+    labels = np.arange(40, dtype=np.int32) % 10
+    root = _write(tmp_path, images, labels, samples_per_shard=16)  # 3 shards
+    ds = ShardedJpegDataset(root)
+    assert len(ds) == 40
+
+    ref_px, ref_y = ds.batch(np.arange(40))  # python-side native decode
+    loader = NativeLoader(
+        ds, batch_size=8, shuffle=False, pad=0, flip=False,
+        normalize=((0.0, 0.0, 0.0), (1.0, 1.0, 1.0)),
+    )
+    got_px, got_y = [], []
+    for x, y in loader:
+        # identity normalize: float = uint8 / 255, exactly invertible
+        got_px.append(np.round(x * 255.0).astype(np.uint8))
+        got_y.append(y)
+    np.testing.assert_array_equal(np.concatenate(got_px), ref_px)
+    np.testing.assert_array_equal(np.concatenate(got_y), ref_y)
+    loader.stop()
+
+
+@pytest.mark.parametrize("subsampling", [0, 1, 2],
+                         ids=["444", "422", "420"])
+def test_native_decoder_matches_pil(subsampling):
+    """csrc/jpeg_decoder.cpp vs PIL/libjpeg on the same streams: equal to
+    ±3 (IDCT rounding); subsampling=2 exercises the 2x triangular
+    chroma-upsampling path."""
+    images = _images(4, h=48, w=40, seed=subsampling)
+    worst = 0
+    for img in images:
+        buf = io.BytesIO()
+        Image.fromarray(img).save(
+            buf, "JPEG", quality=88, subsampling=subsampling
+        )
+        data = np.frombuffer(buf.getvalue(), np.uint8)
+        mine = jpeg_decode_np(data, img.shape)
+        assert mine is not None and mine.shape == img.shape
+        pil = np.asarray(Image.open(io.BytesIO(buf.getvalue())).convert("RGB"))
+        d = np.abs(mine.astype(np.int32) - pil.astype(np.int32))
+        worst = max(worst, int(d.max()))
+        assert d.mean() < 0.5
+    assert worst <= 3
+
+
+def test_sharded_image_dataset_rejects_jpeg_shards(tmp_path):
+    """The ADVICE high: a jpeg-codec root opened with the raw-pixel
+    dataset must say 'use ShardedJpegDataset', not KeyError: 'x'."""
+    images = _images(4)
+    root = _write(tmp_path, images, np.zeros(4, np.int32))
+    with pytest.raises(ValueError, match="ShardedJpegDataset"):
+        ShardedImageDataset(root)
+
+
+def _corrupt_sample(root, ds, idx=0):
+    """Scramble sample ``idx``'s byte stream in shard 0 on disk."""
+    o = ds.offset_tables[0]
+    import json
+    import os
+
+    with open(os.path.join(root, "index.json")) as fp:
+        shard0 = json.load(fp)["shards"][0]["j"]
+    path = os.path.join(root, shard0)
+    with open(path, "r+b") as fp:
+        fp.seek(int(o[idx]))
+        fp.write(b"\x00" * min(64, int(o[idx + 1] - o[idx])))
+
+
+def test_corrupt_stream_raises_at_epoch_end(tmp_path):
+    images = _images(16)
+    root = _write(tmp_path, images, np.zeros(16, np.int32))
+    ds = ShardedJpegDataset(root)
+    _corrupt_sample(root, ds)
+    ds = ShardedJpegDataset(root)  # re-map the corrupted bytes
+    loader = NativeLoader(ds, batch_size=8, shuffle=False, pad=0,
+                          flip=False)
+    with pytest.raises(RuntimeError, match="JPEG decode"):
+        for _ in loader:
+            pass
+
+
+def test_corrupt_stream_surfaces_on_stop_after_early_break(tmp_path):
+    """An early ``break`` skips the epoch-end check; stop() must still
+    report the corrupt samples the broken epoch consumed — and a loader
+    over CLEAN data must stop() silently."""
+    images = _images(16)
+    root = _write(tmp_path, images, np.zeros(16, np.int32))
+    ds = ShardedJpegDataset(root)
+    _corrupt_sample(root, ds)
+    ds = ShardedJpegDataset(root)
+    loader = NativeLoader(ds, batch_size=4, shuffle=False, pad=0,
+                          flip=False, queue_cap=1, num_threads=1)
+    it = iter(loader)
+    next(it)  # batch 0 holds the corrupt sample; break before epoch end
+    del it
+    with pytest.raises(RuntimeError, match="failed JPEG decode"):
+        loader.stop()
+    loader.stop()  # idempotent after the error was consumed
+
+    clean_root = _write(tmp_path / "clean", _images(8),
+                        np.zeros(8, np.int32))
+    clean = NativeLoader(ShardedJpegDataset(clean_root), batch_size=4,
+                         shuffle=False, pad=0, flip=False)
+    for _ in clean:
+        pass
+    clean.stop()  # no decode errors -> no raise

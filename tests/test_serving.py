@@ -1,0 +1,244 @@
+"""Continuous-batching serving engine (ml_trainer_tpu/serving/).
+
+Ground truth is ``generate()``: a request served through the slot engine
+— joining and leaving a running batch at arbitrary token boundaries —
+must reproduce its standalone batch-1 ``generate()`` output
+byte-for-byte, greedy and seeded-sampling alike.  Around that core:
+slot recycling on EOS, admission backpressure, deadlines, metrics, and
+the stdlib HTTP front end.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.generate import generate
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.serving import (
+    AdmissionError,
+    DeadlineExceeded,
+    Server,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = get_model("gpt2_tiny", max_len=64)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables
+
+
+def _prompt(seed, n):
+    return np.asarray(
+        np.random.default_rng(seed).integers(0, 1024, n), np.int32
+    )
+
+
+def test_join_mid_decode_matches_generate_token_for_token(model_and_vars):
+    """The acceptance scenario: two requests submitted MID-STREAM of a
+    running decode; all three outputs byte-identical to standalone
+    generate() calls (greedy and seeded sampling)."""
+    model, variables = model_and_vars
+    pA, pB, pC = _prompt(0, 5), _prompt(1, 3), _prompt(2, 7)
+    refA = np.asarray(generate(model, variables, pA[None], 24))[0]
+    refB = np.asarray(generate(model, variables, pB[None], 8))[0]
+    refC = np.asarray(
+        generate(model, variables, pC[None], 8, temperature=0.7,
+                 rng=jax.random.PRNGKey(42))
+    )[0]
+
+    with Server(model, variables, max_batch=4) as server:
+        sA = server.submit(pA, 24)
+        # Consume A's first token: A is prefillled and actively decoding
+        # when B and C join.
+        itA = iter(sA)
+        next(itA)
+        sB = server.submit(pB, 8)
+        sC = server.submit(pC, 8, temperature=0.7, rng=42)
+        outA = sA.result(timeout=120)
+        outB = sB.result(timeout=120)
+        outC = sC.result(timeout=120)
+        snap = server.metrics.snapshot()
+
+    np.testing.assert_array_equal(outA, refA)
+    np.testing.assert_array_equal(outB, refB)
+    np.testing.assert_array_equal(outC, refC)
+    # Continuous batching actually happened: the engine held more than
+    # one active slot at some decode step.
+    assert snap["max_active_slots"] >= 2
+
+
+def test_streaming_iterator_yields_generates_tokens(model_and_vars):
+    model, variables = model_and_vars
+    p = _prompt(3, 4)
+    ref = np.asarray(generate(model, variables, p[None], 6))[0]
+    with Server(model, variables, max_batch=2) as server:
+        toks = list(server.submit(p, 6))
+    np.testing.assert_array_equal(np.asarray(toks, np.int32), ref[4:])
+
+
+def test_eos_frees_slot_and_truncates(model_and_vars):
+    """A request that hits EOS stops there (its output is generate()'s,
+    cut after the EOS token) and its slot returns to the pool."""
+    model, variables = model_and_vars
+    # EOS := a generated token whose FIRST occurrence is past token 0,
+    # so the request demonstrably decodes a few tokens before stopping.
+    # Greedy decode from a random init can collapse to one repeated
+    # token, so scan prompt seeds for one that yields a usable EOS.
+    for seed in range(4, 64):
+        p = _prompt(seed, 6)
+        ref = np.asarray(generate(model, variables, p[None], 12))[0]
+        gen = ref[6:]
+        k = next(
+            (i for i in range(1, 12) if gen[i] not in gen[:i]), None
+        )
+        if k is not None:
+            break
+    else:
+        pytest.skip("no prompt produced a distinct mid-stream token")
+    eos = int(gen[k])
+    with Server(model, variables, max_batch=2) as server:
+        out = server.complete(p, 12, eos_token_id=eos, timeout=120)
+        # Slot recycled: engine drains and the slot returns to the pool
+        # (poll — the loop thread releases just after the step returns).
+        deadline = time.monotonic() + 10
+        while (server.scheduler.free_slot_count() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        assert server.engine.free_capacity() == 2
+        assert server.scheduler.free_slot_count() == 2
+    np.testing.assert_array_equal(out, ref[: 6 + k + 1])
+    assert out[-1] == eos
+
+
+def test_backpressure_rejects_past_watermark(model_and_vars):
+    model, variables = model_and_vars
+    with Server(model, variables, max_batch=1, max_queue=2) as server:
+        # One long request occupies the only slot...
+        first = server.submit(_prompt(5, 4), 48)
+        iter_first = iter(first)
+        next(iter_first)  # it is actively decoding
+        # ...two more fill the queue; the fourth must be rejected.
+        q1 = server.submit(_prompt(6, 4), 4)
+        q2 = server.submit(_prompt(7, 4), 4)
+        with pytest.raises(AdmissionError, match="watermark"):
+            server.submit(_prompt(8, 4), 4)
+        assert server.metrics.snapshot()["requests_rejected"] == 1
+        for s in (first, q1, q2):
+            s.result(timeout=120)
+
+
+def test_deadline_expires_queued_request(model_and_vars):
+    model, variables = model_and_vars
+    with Server(model, variables, max_batch=1, max_queue=4) as server:
+        blocker = server.submit(_prompt(9, 4), 48)
+        next(iter(blocker))
+        # Deadline far shorter than the blocker's remaining decode.
+        doomed = server.submit(_prompt(10, 4), 4, deadline=1e-3)
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=120)
+        blocker.result(timeout=120)
+
+
+def test_metrics_populated(model_and_vars):
+    model, variables = model_and_vars
+    with Server(model, variables, max_batch=2) as server:
+        server.complete(_prompt(11, 5), 8, timeout=120)
+        server.complete(_prompt(12, 3), 8, timeout=120)
+        snap = server.metrics.log()
+    assert snap["requests_completed"] == 2
+    assert snap["ttft_p50_ms"] > 0
+    assert snap["tokens_per_sec_busy"] > 0
+    assert snap["decode_steps_total"] >= 7  # 2 requests x 7 decode steps
+    assert snap["tokens_total"] == 16
+    assert 0 < snap["slot_occupancy_mean"] <= 1
+
+
+def test_submit_validates_requests(model_and_vars):
+    model, variables = model_and_vars
+    with Server(model, variables, max_batch=1) as server:
+        with pytest.raises(ValueError, match="non-empty"):
+            server.submit(np.asarray([], np.int32), 4)
+        with pytest.raises(ValueError, match="max_len"):
+            server.submit(_prompt(13, 8), 1000)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            server.submit(_prompt(13, 8), 0)
+        with pytest.raises(ValueError, match="eos_token_id"):
+            server.submit(_prompt(13, 8), 4, eos_token_id=50_000)
+
+
+def test_prefill_bucketing_compiles_once_per_bucket(model_and_vars):
+    """Prompt lengths sharing a power-of-two bucket share one compiled
+    prefill program (the compile cache holds one entry per bucket)."""
+    from ml_trainer_tpu.generate import _COMPILED
+
+    model, variables = model_and_vars
+    with Server(model, variables, max_batch=2) as server:
+        for n in (5, 6, 7, 8):  # all in the 8-bucket
+            server.complete(_prompt(n, n), 2, timeout=120)
+    buckets = [
+        k[2] for k in _COMPILED._data if k[0] == "serve_prefill"
+        and k[1] == model
+    ]
+    assert buckets.count(8) == 1
+
+
+def test_http_front_end_round_trip(model_and_vars):
+    import json
+    import urllib.request
+
+    model, variables = model_and_vars
+    p = _prompt(14, 4)
+    ref = np.asarray(generate(model, variables, p[None], 6))[0]
+    with Server(model, variables, max_batch=2) as server:
+        host, port = server.serve_http(port=0)
+        base = f"http://{host}:{port}"
+        body = json.dumps(
+            {"prompt": [int(t) for t in p], "max_new_tokens": 6}
+        ).encode()
+        req = urllib.request.Request(
+            f"{base}/v1/generate", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            snap = json.loads(resp.read())
+        with urllib.request.urlopen(f"{base}/healthz", timeout=30) as resp:
+            assert json.loads(resp.read()) == {"ok": True}
+    np.testing.assert_array_equal(np.asarray(out["tokens"], np.int32), ref)
+    assert snap["requests_completed"] >= 1
+
+
+def test_close_fails_inflight_requests_instead_of_hanging(model_and_vars):
+    """close() with work still queued/active must fail those streams
+    loudly — a blocked result() after shutdown would hang forever."""
+    model, variables = model_and_vars
+    server = Server(model, variables, max_batch=1, max_queue=4)
+    active = server.submit(_prompt(15, 4), 48)
+    next(iter(active))  # occupying the only slot
+    queued = server.submit(_prompt(16, 4), 4)
+    server.close()
+    for s in (active, queued):
+        with pytest.raises(RuntimeError, match="server closed"):
+            s.result(timeout=30)
+
+
+def test_lru_bounds_compiled_programs():
+    from ml_trainer_tpu.utils.utils import LRUCache
+
+    lru = LRUCache(maxsize=3)
+    for i in range(5):
+        lru[i] = i * 10
+    assert len(lru) == 3
+    assert lru.get(0) is None and lru.get(1) is None
+    assert lru.get(4) == 40
+    # get() refreshes recency: 2 survives the next insert, 3 does not.
+    assert lru.get(2) == 20
+    lru[5] = 50
+    assert lru.get(3) is None and lru.get(2) == 20
