@@ -10,6 +10,13 @@ lever of the framework on one command line.
 Levers (env vars): ACCUM (microbatches per update, compiled scan), REMAT
 (jax.checkpoint per block), ZERO1 (optimizer-state sharding over data),
 K (steps per dispatch), TP (tensor-parallel degree over a dp*tp mesh).
+
+Data: a synthetic text corpus tokenized by the REAL in-tree byte-level
+BPE tokenizer (the repo's fixture vocab by default; drop the published
+GPT-2 vocab.json+merges.txt into data/tokenizer/ or set
+ML_TRAINER_TPU_VOCAB_DIR to upgrade) packed into next-token blocks —
+the real GPT-2 data path.  TOKENIZER=synth reverts to raw synthetic
+token ids.
 """
 
 import _bootstrap  # noqa: F401  (repo root onto sys.path)
@@ -30,17 +37,55 @@ EPOCHS = int(os.environ.get("EPOCHS", "2"))
 ACCUM = int(os.environ.get("ACCUM", "2"))
 TP = int(os.environ.get("TP", "1"))
 MODEL_DIR = os.environ.get("MODEL_DIR", "model_output_gpt2")
+TOKENIZER = os.environ.get("TOKENIZER", "bpe")  # 'synth': raw token ids
+
+
+def build_datasets(n, n_val, vocab):
+    """(vocab_size, (train, val)) — real-BPE packed blocks by default,
+    raw synthetic token ids with TOKENIZER=synth."""
+    from ml_trainer_tpu.data.tokenizers import (
+        load_tokenizer,
+        resolve_vocab_dir,
+    )
+
+    tok = None if TOKENIZER == "synth" else load_tokenizer(
+        resolve_vocab_dir()
+    )
+    if tok is None:
+        # Causal-LM pairs: labels are the inputs shifted left
+        # (SyntheticTokens emits them already shifted when num_classes
+        # is None).
+        return vocab, (
+            SyntheticTokens(size=n, seq_len=SEQ_LEN, vocab_size=vocab),
+            SyntheticTokens(size=n_val, seq_len=SEQ_LEN,
+                            vocab_size=vocab, seed=1),
+        )
+    import numpy as np
+
+    from ml_trainer_tpu.data import PackedLMDataset
+
+    vocab = max(vocab, tok.vocab_size)
+    need = (n + n_val) * SEQ_LEN + 2
+    stream = []
+    i = 0
+    while len(stream) < need:
+        stream.extend(tok.encode(
+            f"training step {i}: the tiny gpt model fits the mesh "
+            "and the loss goes down. "
+        ))
+        i += 1
+    stream = np.asarray(stream[:need], np.int32)
+    split = n * SEQ_LEN + 1
+    return vocab, (
+        PackedLMDataset(stream[:split], SEQ_LEN),
+        PackedLMDataset(stream[split - 1:], SEQ_LEN),
+    )
 
 
 def main():
     n = int(os.environ.get("SYNTH_SIZE", "512"))
-    vocab = int(os.environ.get("VOCAB", "1024"))
-    # Causal-LM pairs: labels are the inputs shifted left (SyntheticTokens
-    # emits them already shifted when num_classes is None).
-    datasets = (
-        SyntheticTokens(size=n, seq_len=SEQ_LEN, vocab_size=vocab),
-        SyntheticTokens(size=max(n // 8, 32), seq_len=SEQ_LEN,
-                        vocab_size=vocab, seed=1),
+    vocab, datasets = build_datasets(
+        n, max(n // 8, 32), int(os.environ.get("VOCAB", "1024"))
     )
     model_kw = dict(remat=os.environ.get("REMAT") == "1")
     if MODEL == "gpt2_tiny":

@@ -3,8 +3,11 @@
 
 Uses real GLUE SST-2 TSVs when present (DATA_DIR/train.tsv + dev.tsv),
 otherwise a tiny synthetic sentiment set through the same tokenize →
-TokenizedDataset → Trainer path (this environment has no egress, so the
-offline hash tokenizer stands in for a downloaded vocab).
+TokenizedDataset → Trainer path.  Tokenization is the REAL in-tree
+WordPiece tokenizer (the repo's fixture vocab.txt by default — drop
+the published BERT vocab.txt into data/tokenizer/ or point
+ML_TRAINER_TPU_VOCAB_DIR at it to upgrade); TOKENIZER=hash opts back
+into the deterministic hash stand-in.
 
     python examples/05_bert_finetune.py                       # tiny, smoke
     MODEL=bert_base DATA_DIR=data/sst2 EPOCHS=3 BATCH=32 \
@@ -36,20 +39,27 @@ SYNTH = [
 ] * 16
 
 
+# WordPiece is the BERT-shaped encoding; 'hash' reverts to the stand-in.
+TOKENIZER = os.environ.get("TOKENIZER", "wordpiece")
+
+
 def build_datasets(vocab_size):
     try:
         return (
             load_sst2_tsv(os.path.join(DATA_DIR, "train.tsv"),
-                          max_len=MAX_LEN, vocab_size=vocab_size),
+                          max_len=MAX_LEN, vocab_size=vocab_size,
+                          tokenizer=TOKENIZER),
             load_sst2_tsv(os.path.join(DATA_DIR, "dev.tsv"),
-                          max_len=MAX_LEN, vocab_size=vocab_size),
+                          max_len=MAX_LEN, vocab_size=vocab_size,
+                          tokenizer=TOKENIZER),
         )
     except (FileNotFoundError, OSError):
         print("SST-2 TSVs not on disk; using the synthetic sentiment set")
         texts, labels = zip(*SYNTH)
         n = len(texts) * 3 // 4
         mk = lambda t, l: TokenizedDataset.from_texts(  # noqa: E731
-            t, l, max_len=MAX_LEN, vocab_size=vocab_size
+            t, l, max_len=MAX_LEN, vocab_size=vocab_size,
+            tokenizer=TOKENIZER,
         )
         return mk(texts[:n], labels[:n]), mk(texts[n:], labels[n:])
 

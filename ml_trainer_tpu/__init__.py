@@ -26,6 +26,11 @@ from ml_trainer_tpu.data import Loader, ArrayDataset, ShardedSampler
 from ml_trainer_tpu.models import MLModel
 from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
 from ml_trainer_tpu.generate import beam_search, generate, generate_ragged
+from ml_trainer_tpu.speculative import (
+    DraftModelDrafter,
+    NgramDrafter,
+    speculative_generate,
+)
 
 __version__ = "0.4.0"  # kept in lockstep with pyproject.toml (test-pinned)
 
@@ -43,5 +48,8 @@ __all__ = [
     "generate",
     "generate_ragged",
     "beam_search",
+    "speculative_generate",
+    "NgramDrafter",
+    "DraftModelDrafter",
     "__version__",
 ]

@@ -5,10 +5,11 @@ The reference has no text pipeline at all; this module provides:
 
 * ``TokenizedDataset`` — padded [N, S] token ids (+ labels), an ArrayDataset
   so the Loader's fast batched-gather path applies;
-* ``tokenize_texts`` — HuggingFace tokenizer wrapper (transformers is an
-  optional dependency; a deterministic hash tokenizer stands in when the
-  pretrained vocab files aren't on disk, keeping the path testable in
-  zero-egress environments);
+* ``tokenize_texts`` — real tokenization by default: the IN-TREE
+  byte-BPE/WordPiece tokenizers (data/tokenizers.py; the repo's fixture
+  vocabs as zero-egress last resort) rank ahead of ``transformers``,
+  and the deterministic hash stand-in is an explicit opt-in
+  (``tokenizer='hash'``);
 * ``load_sst2_tsv`` — the GLUE SST-2 on-disk format (sentence\\tlabel).
 * ``PackedLMDataset`` — concatenate-and-chunk token stream for causal-LM
   pretraining (every token supervised, no padding waste).
@@ -53,83 +54,132 @@ def tokenize_texts(
     tokenizer_name: Optional[str] = None,
     vocab_size: int = 30522,
     vocab_dir: Optional[str] = None,
+    tokenizer: str = "auto",
 ) -> Tuple[np.ndarray, np.ndarray]:
     """Texts -> (input_ids [N, max_len], attention_mask [N, max_len]).
 
-    Tokenizer preference order:
+    Tokenizer preference order (``tokenizer='auto'``):
 
-    1. ``transformers.AutoTokenizer`` when ``tokenizer_name`` is given
-       and loadable (local files honored; no download attempted);
-    2. the IN-TREE tokenizers (data/tokenizers.py) when ``vocab_dir`` —
-       or ``$ML_TRAINER_TPU_VOCAB_DIR``, or ``data/tokenizer/`` —
-       holds real vocab files (``vocab.json``+``merges.txt`` ->
-       byte-level BPE; ``vocab.txt`` -> WordPiece).  Token ids then come
-       from that vocab: build the model with the tokenizer's
-       ``vocab_size``, not this function's ``vocab_size`` argument;
-    3. the deterministic hash fallback (zero-egress testability),
-       bounded by ``vocab_size``, with BERT-style [CLS] ... [SEP]
-       framing.
+    1. the IN-TREE tokenizers (data/tokenizers.py) from ``vocab_dir`` —
+       or ``$ML_TRAINER_TPU_VOCAB_DIR``, or ``data/tokenizer/``, or the
+       repo's committed fixture vocabs as last resort
+       (``vocab.json``+``merges.txt`` -> byte-level BPE; ``vocab.txt``
+       -> WordPiece; ``tokenizer='bpe'``/``'wordpiece'`` tie-breaks a
+       dir holding both).  Token ids then come from that vocab: build
+       the model with the tokenizer's ``vocab_size``, not this
+       function's ``vocab_size`` argument;
+    2. ``transformers.AutoTokenizer`` when ``tokenizer_name`` is given
+       and loadable (local files honored; no download attempted) —
+       an explicit ``tokenizer_name`` also disables the fixture-vocab
+       fallback in step 1, so it cannot be shadowed by defaults;
+    3. the deterministic hash stand-in ONLY by explicit opt-in
+       (``tokenizer='hash'``), bounded by ``vocab_size``, with
+       BERT-style [CLS] ... [SEP] framing — or, with a loud warning, as
+       the final fallback when nothing else is available.
     """
-    if tokenizer_name is not None:
-        try:
-            from transformers import AutoTokenizer
+    if tokenizer not in ("auto", "bpe", "wordpiece", "hash"):
+        raise ValueError(
+            "tokenizer must be 'auto', 'bpe', 'wordpiece' or 'hash', "
+            f"got {tokenizer!r}"
+        )
+    if tokenizer != "hash":
+        from ml_trainer_tpu.data.tokenizers import (
+            encode_batch,
+            fixture_vocab_dir,
+            load_tokenizer,
+            resolve_vocab_dir,
+        )
 
-            tok = AutoTokenizer.from_pretrained(
-                tokenizer_name, local_files_only=True
+        implicit = vocab_dir is None and not os.environ.get(
+            "ML_TRAINER_TPU_VOCAB_DIR"
+        )
+        resolved = resolve_vocab_dir(vocab_dir)
+        if (
+            tokenizer_name is not None
+            and implicit
+            and resolved == fixture_vocab_dir()
+        ):
+            # The caller named a transformers tokenizer and no real
+            # vocab dir was configured: the fixture fallback must not
+            # shadow the explicit request.
+            resolved = ""
+        prefer = tokenizer if tokenizer in ("bpe", "wordpiece") else None
+        tok = (
+            load_tokenizer(resolved, prefer=prefer)
+            if resolved and os.path.isdir(resolved) else None
+        )
+        if tok is None and tokenizer in ("bpe", "wordpiece"):
+            raise FileNotFoundError(
+                f"tokenizer={tokenizer!r} requested but no vocab files "
+                f"in {resolved!r}"
             )
-            enc = tok(
-                list(texts), max_length=max_len, padding="max_length",
-                truncation=True, return_tensors="np",
-            )
-            return (
-                enc["input_ids"].astype(np.int32),
-                enc["attention_mask"].astype(np.int32),
-            )
-        except Exception:
-            pass  # fall through to the offline tokenizers
-    from ml_trainer_tpu.data.tokenizers import (
-        encode_batch,
-        load_tokenizer,
-        resolve_vocab_dir,
-    )
+        if (
+            tok is not None
+            and implicit
+            and resolved == os.path.join("data", "tokenizer")
+        ):
+            # The mere presence of a CWD-relative data/tokenizer/
+            # changes token ids for callers that never asked for it;
+            # say so ONCE per process so the switch is visible, not
+            # silent.  (The fixture fallback is the documented default
+            # and does not warn.)
+            global _warned_implicit_vocab
+            if not _warned_implicit_vocab:
+                _warned_implicit_vocab = True
+                import warnings
 
-    implicit = vocab_dir is None and not os.environ.get(
-        "ML_TRAINER_TPU_VOCAB_DIR"
-    )
-    vocab_dir = resolve_vocab_dir(vocab_dir)
-    tok = load_tokenizer(vocab_dir) if os.path.isdir(vocab_dir) else None
-    if tok is not None and implicit:
-        # The mere presence of a CWD-relative data/tokenizer/ changes
-        # token ids for callers that never asked for it; say so ONCE per
-        # process so the switch is visible, not silent.
-        global _warned_implicit_vocab
-        if not _warned_implicit_vocab:
-            _warned_implicit_vocab = True
+                warnings.warn(
+                    f"tokenize_texts discovered a vocab in {resolved!r} "
+                    "(CWD-relative default) and will use it instead of "
+                    "the fixture default; pass vocab_dir=... or set "
+                    "ML_TRAINER_TPU_VOCAB_DIR to make this explicit",
+                    stacklevel=2,
+                )
+        if tok is not None:
+            if tok.vocab_size <= vocab_size:
+                return encode_batch(tok, texts, max_len)
+            # The caller's model embeds only ``vocab_size`` rows;
+            # emitting larger ids would gather garbage SILENTLY (XLA
+            # clamps out-of-range indices).  Skip the in-tree tokenizer
+            # rather than poison training, and say why.
             import warnings
 
             warnings.warn(
-                f"tokenize_texts discovered a vocab in {vocab_dir!r} "
-                "(CWD-relative default) and will use it instead of the "
-                "hash fallback; pass vocab_dir=... or set "
-                "ML_TRAINER_TPU_VOCAB_DIR to make this explicit",
+                f"tokenizer in {resolved!r} has vocab_size "
+                f"{tok.vocab_size} > the declared embedding size "
+                f"{vocab_size}; falling back to the hash tokenizer. "
+                f"Build the model with vocab_size={tok.vocab_size} to "
+                "use it.",
                 stacklevel=2,
             )
-    if tok is not None:
-        if tok.vocab_size <= vocab_size:
-            return encode_batch(tok, texts, max_len)
-        # The caller's model embeds only ``vocab_size`` rows; emitting
-        # larger ids would gather garbage SILENTLY (XLA clamps
-        # out-of-range indices).  Skip the in-tree tokenizer rather
-        # than poison training, and say why.
-        import warnings
+        if tok is None and tokenizer_name is not None:
+            try:
+                from transformers import AutoTokenizer
 
-        warnings.warn(
-            f"tokenizer in {vocab_dir!r} has vocab_size "
-            f"{tok.vocab_size} > the declared embedding size "
-            f"{vocab_size}; falling back to the hash tokenizer. Build "
-            f"the model with vocab_size={tok.vocab_size} to use it.",
-            stacklevel=2,
-        )
+                hf = AutoTokenizer.from_pretrained(
+                    tokenizer_name, local_files_only=True
+                )
+                enc = hf(
+                    list(texts), max_length=max_len, padding="max_length",
+                    truncation=True, return_tensors="np",
+                )
+                return (
+                    enc["input_ids"].astype(np.int32),
+                    enc["attention_mask"].astype(np.int32),
+                )
+            except Exception:
+                pass  # fall through to the hash stand-in
+        if tok is None:
+            import warnings
+
+            warnings.warn(
+                "no tokenizer vocab found anywhere (vocab_dir, "
+                "$ML_TRAINER_TPU_VOCAB_DIR, data/tokenizer/, repo "
+                "fixtures); using the hash stand-in tokenizer — pass "
+                "tokenizer='hash' to opt in explicitly and silence "
+                "this warning",
+                stacklevel=2,
+            )
     ids = np.zeros((len(texts), max_len), np.int32)
     mask = np.zeros((len(texts), max_len), np.int32)
     for i, text in enumerate(texts):
@@ -156,11 +206,15 @@ class TokenizedDataset(ArrayDataset):
     def from_texts(cls, texts: Sequence[str], labels: Sequence[int],
                    max_len: int = 128, tokenizer_name: Optional[str] = None,
                    vocab_size: int = 30522,
-                   vocab_dir: Optional[str] = None):
+                   vocab_dir: Optional[str] = None,
+                   tokenizer: str = "auto"):
         """``vocab_size`` bounds the offline tokenizer's ids — it MUST match
-        the model's embedding table (out-of-range ids gather garbage)."""
+        the model's embedding table (out-of-range ids gather garbage).
+        By default the in-tree tokenizers encode (fixture vocabs as last
+        resort); ``tokenizer='hash'`` opts into the hash stand-in."""
         ids, mask = tokenize_texts(
-            texts, max_len, tokenizer_name, vocab_size, vocab_dir
+            texts, max_len, tokenizer_name, vocab_size, vocab_dir,
+            tokenizer=tokenizer,
         )
         return cls(ids, np.asarray(labels), mask)
 
@@ -168,7 +222,8 @@ class TokenizedDataset(ArrayDataset):
 def load_sst2_tsv(path: str, max_len: int = 128,
                   tokenizer_name: Optional[str] = None,
                   vocab_size: int = 30522,
-                  vocab_dir: Optional[str] = None) -> TokenizedDataset:
+                  vocab_dir: Optional[str] = None,
+                  tokenizer: str = "auto") -> TokenizedDataset:
     """GLUE SST-2 ``train.tsv``/``dev.tsv`` (header, sentence\\tlabel)."""
     texts, labels = [], []
     with open(path) as fp:
@@ -179,7 +234,8 @@ def load_sst2_tsv(path: str, max_len: int = 128,
                 texts.append(sentence)
                 labels.append(int(label))
     return TokenizedDataset.from_texts(
-        texts, labels, max_len, tokenizer_name, vocab_size, vocab_dir
+        texts, labels, max_len, tokenizer_name, vocab_size, vocab_dir,
+        tokenizer=tokenizer,
     )
 
 

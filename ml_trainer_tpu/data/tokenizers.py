@@ -377,32 +377,58 @@ class WordPieceTokenizer:
 
 
 # --------------------------------------------------------------- discovery
+def fixture_vocab_dir() -> Optional[str]:
+    """The repo's committed fixture vocabs (tests/fixtures/tokenizers:
+    byte-BPE vocab.json+merges.txt AND WordPiece vocab.txt) — the
+    zero-egress LAST-RESORT default, so the flagship text paths run real
+    tokenization out of the box instead of the hash stand-in.  ``None``
+    when the package is installed without the repo checkout."""
+    root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    d = os.path.join(root, "tests", "fixtures", "tokenizers")
+    return d if os.path.isdir(d) else None
+
+
 def resolve_vocab_dir(vocab_dir: Optional[str] = None) -> str:
     """The single discovery policy: explicit argument, else
     ``$ML_TRAINER_TPU_VOCAB_DIR``, else ``data/tokenizer/`` relative to
     the working directory (the conventional drop-in spot for pretrained
-    vocab files)."""
-    return (
-        vocab_dir
-        or os.environ.get("ML_TRAINER_TPU_VOCAB_DIR")
-        or os.path.join("data", "tokenizer")
-    )
+    vocab files) when it exists, else the committed fixture vocabs
+    (:func:`fixture_vocab_dir`)."""
+    if vocab_dir:
+        return vocab_dir
+    env = os.environ.get("ML_TRAINER_TPU_VOCAB_DIR")
+    if env:
+        return env
+    cwd_default = os.path.join("data", "tokenizer")
+    if os.path.isdir(cwd_default):
+        return cwd_default
+    fix = fixture_vocab_dir()
+    return fix if fix is not None else cwd_default
 
 
-def load_tokenizer(vocab_dir: str):
+def load_tokenizer(vocab_dir: str, prefer: Optional[str] = None):
     """Build whichever tokenizer ``vocab_dir``'s files describe.
 
     ``vocab.json`` + ``merges.txt`` -> :class:`ByteLevelBPETokenizer`;
     ``vocab.txt`` -> :class:`WordPieceTokenizer`; neither -> ``None``.
-    This is how ``tokenize_texts`` (data/text.py) discovers real
-    tokenization before falling back to the hash stand-in."""
+    When BOTH file sets exist, BPE wins unless ``prefer='wordpiece'``
+    (the BERT-shaped callers ask for WordPiece explicitly).  This is how
+    ``tokenize_texts`` (data/text.py) discovers real tokenization."""
+    if prefer not in (None, "bpe", "wordpiece"):
+        raise ValueError(
+            f"prefer must be None, 'bpe' or 'wordpiece', got {prefer!r}"
+        )
     vj = os.path.join(vocab_dir, "vocab.json")
     mt = os.path.join(vocab_dir, "merges.txt")
     vt = os.path.join(vocab_dir, "vocab.txt")
-    if os.path.exists(vj) and os.path.exists(mt):
-        return ByteLevelBPETokenizer.from_files(vj, mt)
-    if os.path.exists(vt):
+    has_bpe = os.path.exists(vj) and os.path.exists(mt)
+    has_wp = os.path.exists(vt)
+    if has_wp and (prefer == "wordpiece" or not has_bpe):
         return WordPieceTokenizer.from_files(vt)
+    if has_bpe:
+        return ByteLevelBPETokenizer.from_files(vj, mt)
     return None
 
 

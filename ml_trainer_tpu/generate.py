@@ -48,6 +48,9 @@ def generate(
     eos_token_id: Optional[int] = None,
     pad_token_id: int = 0,
     rng: Optional[jax.Array] = None,
+    spec_k: int = 0,
+    drafter="ngram",
+    draft_variables: Optional[dict] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt_ids`` [B, P].
 
@@ -63,7 +66,27 @@ def generate(
     still runs — static shapes are the whole design — the finished
     row's draws are just masked out).  Returns
     [B, P + max_new_tokens] token ids.
+
+    ``spec_k > 0`` routes through speculative decoding
+    (``ml_trainer_tpu.speculative``): ``drafter`` proposes ``spec_k``
+    tokens per step and one verify forward scores them all.  Greedy
+    output is byte-identical to the vanilla loop; ``top_k``/``top_p``
+    are not supported on this path.
     """
+    if spec_k:
+        if top_k is not None or top_p is not None:
+            raise ValueError(
+                "speculative decoding (spec_k > 0) does not support "
+                "top_k/top_p filtering — use spec_k=0"
+            )
+        from ml_trainer_tpu.speculative import speculative_generate
+
+        return speculative_generate(
+            model, variables, prompt_ids, max_new_tokens,
+            draft_k=spec_k, drafter=drafter,
+            draft_variables=draft_variables, temperature=temperature,
+            rng=rng, eos_token_id=eos_token_id, pad_token_id=pad_token_id,
+        )
     params = variables["params"] if "params" in variables else variables
     b, prompt_len = prompt_ids.shape
     if max_new_tokens < 0:

@@ -37,14 +37,17 @@ def _embed_input(mdl: nn.Module, input_ids, pos_start=None):
     if pos_start is None:
         pos_slice = pos[:, :s]
     elif getattr(pos_start, "ndim", 0) == 1:
-        # Per-row positions (serving slots: each batch row decodes at its
-        # own sequence position) — only the single-token step applies.
-        if s != 1:
-            raise ValueError(
-                "per-row pos_start (serving slots) supports only "
-                f"single-token decode steps, got seq len {s}"
-            )
-        pos_slice = jnp.take(pos[0], pos_start, axis=0)[:, None, :]
+        # Per-row positions (serving slots / speculative verify windows:
+        # each batch row sits at its own sequence position).  ``s == 1``
+        # is the decode step; ``s > 1`` gathers a length-s position
+        # window per row (clipped at max_len — out-of-range rows are
+        # inactive slots whose outputs nobody reads).
+        pos_slice = jnp.take(
+            pos[0],
+            pos_start[:, None] + jnp.arange(s)[None, :],
+            axis=0,
+            mode="clip",
+        )
     else:
         pos_slice = _jax.lax.dynamic_slice(
             pos, (0, pos_start, 0), (1, s, mdl.embed_dim)
@@ -174,6 +177,36 @@ def gpt2_moe_tiny(**kw) -> GPT2:
     test/demo config (mesh axis ``expert``, rules_for(..., 'ep'))."""
     kw.setdefault("moe_experts", 4)
     return gpt2_tiny(**kw)
+
+
+@register_model("gpt2_mini")
+def gpt2_mini(**kw) -> GPT2:
+    """Mid-size GPT-2 (≈29M params): 4 layers, 512 wide, 8k vocab.
+
+    The speculative-decoding bench/serving demo target: large enough
+    that a decode forward is weight-streaming-bound — a K+1-token verify
+    window costs ~2x a single-token step, not K+1x — which is the regime
+    where drafting pays (bench.py --spec)."""
+    kw.setdefault("vocab_size", 8192)
+    kw.setdefault("embed_dim", 512)
+    kw.setdefault("depth", 4)
+    kw.setdefault("num_heads", 8)
+    kw.setdefault("max_len", 512)
+    return GPT2(**kw)
+
+
+@register_model("gpt2_nano")
+def gpt2_nano(**kw) -> GPT2:
+    """Draft-model config paired with ``gpt2_mini``: 1 layer, 128 wide,
+    the SAME 8k vocabulary (speculative acceptance compares token ids, so
+    vocab identity is the compatibility contract — models/registry.py
+    records the pairing)."""
+    kw.setdefault("vocab_size", 8192)
+    kw.setdefault("embed_dim", 128)
+    kw.setdefault("depth", 1)
+    kw.setdefault("num_heads", 4)
+    kw.setdefault("max_len", 512)
+    return GPT2(**kw)
 
 
 class GPT2Pipelined(nn.Module):

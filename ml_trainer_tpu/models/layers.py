@@ -108,18 +108,18 @@ class MultiHeadAttention(nn.Module):
             # Slot-indexed serving mode (serving/engine.py): ``cache_index``
             # is a PER-ROW [B] vector — each batch row (slot) sits at its
             # own sequence position, so rows write K/V at their own index
-            # and attend their own valid prefix.  Only the single-token
-            # decode step supports this; prefill runs per request at
-            # batch 1 with the ordinary scalar index and is inserted into
-            # the slot cache afterwards.
-            if s != 1:
-                raise ValueError(
-                    "per-row cache_index (serving slots) supports only "
-                    f"single-token decode steps, got seq len {s}"
-                )
+            # and attend their own valid prefix.  ``s == 1`` is the
+            # ordinary decode step; ``s > 1`` is the speculative VERIFY
+            # window (speculative.py): a length-``s`` token window lands
+            # at each row's own dynamic offset — one dynamic_update_slice
+            # per row, shapes static at fixed ``s``, so a fixed draft
+            # length K never recompiles — and query position j attends
+            # cached positions <= idx + j (the in-window causal rule).
+            # Prefill still runs per request at batch 1 with the ordinary
+            # scalar index and is inserted into the slot cache afterwards.
 
             def write_row(cache_row, kv_row, i):
-                # [H, L, D] <- [H, 1, D] at position i of THIS row only.
+                # [H, L, D] <- [H, s, D] at position i of THIS row only.
                 return jax.lax.dynamic_update_slice(
                     cache_row, kv_row, (0, i, 0)
                 )
@@ -132,8 +132,9 @@ class MultiHeadAttention(nn.Module):
             )
             idx_var.value = idx + s
             valid = (
-                jnp.arange(L)[None, :] <= idx[:, None]
-            )[:, None, None, :]
+                jnp.arange(L)[None, None, :]
+                <= idx[:, None, None] + jnp.arange(s)[None, :, None]
+            )[:, None, :, :]
             return attention(
                 q, cached_k.value, cached_v.value,
                 causal=False, mask=valid, implementation="xla",

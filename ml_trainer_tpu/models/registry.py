@@ -14,6 +14,18 @@ import flax.linen as nn
 
 MODELS: Dict[str, Callable[..., nn.Module]] = {}
 
+# Target-model name -> suggested draft-model name for speculative
+# decoding (ml_trainer_tpu/speculative.py).  A valid pair shares one
+# vocabulary — acceptance compares token ids across the two models — so
+# the pairing is registered next to the models instead of guessed at
+# call sites.
+DRAFT_PAIRS: Dict[str, str] = {
+    "gpt2_mini": "gpt2_nano",
+    # The 50257-vocab family has no small partner in the zoo yet
+    # (gpt2_tiny's synthetic 1024 vocab is NOT compatible); the n-gram
+    # drafter covers those targets model-free.
+}
+
 _FAMILY_MODULES = ("mlmodel", "resnet", "vit", "bert", "gpt2", "llama")
 
 
@@ -46,3 +58,17 @@ def get_model(name: str, **kwargs) -> nn.Module:
 def available_models():
     _load_families()
     return sorted(MODELS)
+
+
+def suggested_draft(name: str, **kwargs) -> nn.Module:
+    """Build the registered draft-model partner of target ``name`` (for
+    speculative decoding).  Raises ``ValueError`` when no pairing is
+    registered — callers should then fall back to the model-free n-gram
+    drafter rather than guess a vocabulary-incompatible model."""
+    if name not in DRAFT_PAIRS:
+        raise ValueError(
+            f"no draft model registered for {name!r} "
+            f"(known pairs: {sorted(DRAFT_PAIRS)}); use the n-gram "
+            "lookup drafter instead"
+        )
+    return get_model(DRAFT_PAIRS[name], **kwargs)

@@ -95,10 +95,13 @@ class Server:
                  max_queue: int = 64,
                  metrics: Optional[ServingMetrics] = None,
                  idle_poll: float = 0.02,
-                 http_port: Optional[int] = None):
+                 http_port: Optional[int] = None,
+                 spec_k: int = 0, drafter="ngram",
+                 draft_variables: Optional[dict] = None):
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.engine = SlotDecodeEngine(
-            model, variables, max_batch=max_batch, metrics=self.metrics
+            model, variables, max_batch=max_batch, metrics=self.metrics,
+            spec_k=spec_k, drafter=drafter, draft_variables=draft_variables,
         )
         self.scheduler = FifoScheduler(
             max_batch, max_queue=max_queue, metrics=self.metrics
@@ -134,10 +137,17 @@ class Server:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}"
             )
-        if prompt.size + max_new_tokens > self.engine.max_len:
+        if prompt.size + max_new_tokens + self.engine.spec_k > \
+                self.engine.max_len:
+            extra = (
+                f" + spec_k ({self.engine.spec_k}) — the speculative "
+                "verify window needs spec_k tokens of cache slack"
+                if self.engine.spec_k else ""
+            )
             raise ValueError(
-                f"prompt ({prompt.size}) + new tokens ({max_new_tokens}) "
-                f"exceeds the model's max_len ({self.engine.max_len})"
+                f"prompt ({prompt.size}) + new tokens ({max_new_tokens})"
+                f"{extra} exceeds the model's max_len "
+                f"({self.engine.max_len})"
             )
         if eos_token_id is not None and not (
             0 <= eos_token_id < self.engine.vocab_size

@@ -38,6 +38,15 @@ standalone batch-1 ``generate()`` call for the same request.
 Compiled programs (prefill buckets, the decode step, the slot insert)
 live in the process-wide LRU shared with ``generate._COMPILED``, so one
 bound covers every decode executable in the process.
+
+* **Speculative mode** (``spec_k > 0``, see speculative.py and
+  docs/serving.md): each step drafts ``spec_k`` tokens per slot (n-gram
+  lookup over the request's own history, or a vocab-compatible draft
+  model with its own slot cache) and ONE verify forward over a
+  ``[max_batch, spec_k+1]`` window commits a variable 1..spec_k+1
+  tokens per slot — still one static-shaped executable at fixed K, so
+  join/leave semantics and the no-recompilation guarantee carry over
+  unchanged.  Greedy slots stay byte-identical to ``generate()``.
 """
 
 from __future__ import annotations
@@ -52,6 +61,12 @@ import numpy as np
 from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, _empty_cache
 from ml_trainer_tpu.serving.metrics import ServingMetrics
 from ml_trainer_tpu.serving.scheduler import Request
+from ml_trainer_tpu.speculative import (
+    DraftModelDrafter,
+    NgramDrafter,
+    build_draft_scan,
+    build_verify,
+)
 
 
 def _as_key(rng) -> np.ndarray:
@@ -86,13 +101,21 @@ class SlotDecodeEngine:
     ``step``; thread-safe admission lives in the scheduler."""
 
     def __init__(self, model, variables: dict, max_batch: int = 8,
-                 metrics: Optional[ServingMetrics] = None):
+                 metrics: Optional[ServingMetrics] = None,
+                 spec_k: int = 0, drafter="ngram",
+                 draft_variables: Optional[dict] = None,
+                 ngram_n: int = 3):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if not getattr(model, "max_len", 0):
             raise ValueError(
                 "serving needs a causal LM exposing decode/max_len "
                 f"(got {type(model).__name__})"
+            )
+        if spec_k < 0 or spec_k >= int(model.max_len):
+            raise ValueError(
+                f"spec_k must be in [0, max_len={model.max_len}), "
+                f"got {spec_k}"
             )
         self.model = model
         self.dm = model.clone(decode=True)
@@ -126,6 +149,70 @@ class SlotDecodeEngine:
         self._insert = self._program(
             ("serve_insert", model, max_batch), self._build_insert
         )
+
+        # -- speculative decoding (opt-in; see speculative.py) ----------
+        # Slots advance a variable 1..spec_k+1 tokens per verify step;
+        # all shapes stay static at fixed spec_k, so ragged join/leave
+        # traffic still never recompiles.
+        self.spec_k = int(spec_k)
+        self._ngram: Optional[NgramDrafter] = None
+        self._draft: Optional[DraftModelDrafter] = None
+        if self.spec_k:
+            if drafter == "ngram":
+                self._ngram = NgramDrafter(k=self.spec_k, n=ngram_n)
+            elif isinstance(drafter, DraftModelDrafter):
+                self._draft = drafter
+            elif hasattr(drafter, "max_len"):
+                if draft_variables is None:
+                    raise ValueError(
+                        "a draft model needs draft_variables (its params)"
+                    )
+                self._draft = DraftModelDrafter(drafter, draft_variables)
+            else:
+                raise ValueError(
+                    "drafter must be 'ngram', a DraftModelDrafter or a "
+                    f"registry model, got {drafter!r}"
+                )
+            self._verify = self._program(
+                ("spec_verify", model, max_batch, self.spec_k + 1),
+                lambda: build_verify(model, max_batch, self.spec_k + 1),
+            )
+            # Host-owned consumed-token counts and write caps per slot
+            # (the verify window writes spec_k+1 positions at pos, so
+            # pos is clamped to keep every write inside max_len).
+            self._pos = np.zeros((max_batch,), np.int32)
+            self._caps = np.full(
+                (max_batch,), self.max_len - self.spec_k - 1, np.int32
+            )
+            if self._draft is not None:
+                self._draft.check_compatible(model)
+                d_model = self._draft.model
+                if int(d_model.max_len) < self.max_len:
+                    raise ValueError(
+                        f"draft model max_len ({d_model.max_len}) must "
+                        f"cover the target's ({self.max_len})"
+                    )
+                self._draft_dm = d_model.clone(decode=True)
+                self._draft_shapes_b1 = _cache_shapes(
+                    self._draft_dm, 1, jnp.int32
+                )
+                d_shapes = _cache_shapes(self._draft_dm, max_batch, jnp.int32)
+                self._draft_cache = jax.tree.map(
+                    lambda s: jnp.zeros(
+                        (max_batch,) if s.ndim == 0 else s.shape, s.dtype
+                    ),
+                    d_shapes,
+                )
+                self._draft_tok = jnp.zeros((max_batch, 1), jnp.int32)
+                self._draft_scan = self._program(
+                    ("spec_draft", d_model, max_batch, self.spec_k),
+                    lambda: build_draft_scan(
+                        d_model, max_batch, self.spec_k
+                    ),
+                )
+                self._draft_insert = self._program(
+                    ("serve_insert", d_model, max_batch), self._build_insert
+                )
 
     # -- compiled programs ----------------------------------------------
 
@@ -171,9 +258,9 @@ class SlotDecodeEngine:
 
         return jax.jit(insert, donate_argnums=(0, 1))
 
-    def _build_prefill(self, bucket: int):
-        dm = self.dm
-        shapes = self._shapes_b1
+    def _build_prefill(self, bucket: int, dm=None, shapes=None):
+        dm = dm if dm is not None else self.dm
+        shapes = shapes if shapes is not None else self._shapes_b1
 
         def prefill(params, prompt_pad, true_len, temp, rng):
             cache = _empty_cache(shapes)
@@ -228,6 +315,30 @@ class SlotDecodeEngine:
         self.cache, self.tok = self._insert(
             self.cache, self.tok, cache1, tok0, np.int32(slot), np.int32(p)
         )
+        if self.spec_k:
+            self._pos[slot] = p
+            self._caps[slot] = min(
+                p + req.max_new_tokens - 1, self.max_len - self.spec_k - 1
+            )
+            if self._draft is not None:
+                # The draft model prefills the same padded prompt into
+                # ITS slot cache (its own bucketed programs); the draft
+                # prefill's sampled token is discarded — only the K/V
+                # state matters for drafting.
+                d_run = self._program(
+                    ("serve_prefill", self._draft.model, bucket),
+                    lambda: self._build_prefill(
+                        bucket, self._draft_dm, self._draft_shapes_b1
+                    ),
+                )
+                d_cache1, d_tok0 = d_run(
+                    self._draft.params, padded, np.int32(p),
+                    jnp.asarray(req.temperature, jnp.float32), key,
+                )
+                self._draft_cache, self._draft_tok = self._draft_insert(
+                    self._draft_cache, self._draft_tok, d_cache1, d_tok0,
+                    np.int32(slot), np.int32(p),
+                )
         tok0 = np.asarray(tok0)  # blocks until prefill + insert land
         self.metrics.record_prefill(time.perf_counter() - t0)
         self._temps[slot] = req.temperature
@@ -254,9 +365,12 @@ class SlotDecodeEngine:
 
     def step(self) -> List[int]:
         """One compiled decode step over all slots; distributes each
-        active slot's token and returns the slots freed this step."""
+        active slot's token(s) and returns the slots freed this step.
+        In spec mode each slot advances 1..spec_k+1 tokens."""
         if not self._active:
             return []
+        if self.spec_k:
+            return self._step_spec()
         active_before = len(self._active)
         t0 = time.perf_counter()
         self.cache, self.tok = self._decode(
@@ -287,4 +401,79 @@ class SlotDecodeEngine:
             if self._finished(req, token):
                 freed.append(slot)
         self.metrics.record_step(dt, active_before, self.max_batch, emitted)
+        return freed
+
+    def _step_spec(self) -> List[int]:
+        """One speculative verify step over all slots: draft spec_k
+        tokens per slot (lookup or draft model), score the whole
+        [max_batch, spec_k+1] window in ONE target forward, commit each
+        slot's accepted prefix + 1.  Greedy slots reproduce the vanilla
+        path byte-for-byte (longest-accepted-prefix); sampled slots use
+        rejection sampling (same distribution, different draw stream
+        than the vanilla per-token fold)."""
+        active_before = len(self._active)
+        k = self.spec_k
+        t0 = time.perf_counter()
+        if self._draft is not None:
+            self._draft_cache, drafts_dev = self._draft_scan(
+                self._draft.params, self._draft_cache, self.tok,
+                jnp.asarray(self._pos),
+            )
+            drafts = np.asarray(drafts_dev)
+        else:
+            # Per-slot draft state: the lookup history is the request's
+            # own prompt + committed tokens.  Inactive slots draft
+            # zeros — their rows compute masked garbage nobody reads.
+            drafts = np.zeros((self.max_batch, k), np.int32)
+            for slot, req in self._active.items():
+                hist = np.concatenate([
+                    np.asarray(req.prompt, np.int32).reshape(-1),
+                    np.asarray(req.tokens, np.int32),
+                ])
+                drafts[slot] = self._ngram.draft_one(hist)
+        window = jnp.concatenate(
+            [self.tok, jnp.asarray(drafts, jnp.int32)], axis=1
+        )
+        self.cache, accepted, self.tok, _ = self._verify(
+            self.params, self.cache, window, jnp.asarray(self._pos),
+            jnp.asarray(self._caps), self._temps, self._rngs, self._steps,
+        )
+        acc = np.asarray(accepted)
+        toks = np.asarray(self.tok[:, 0])  # blocks until the step lands
+        dt = time.perf_counter() - t0
+        freed: List[int] = []
+        emitted = 0
+        acc_active: List[int] = []
+        now = time.monotonic()
+        for slot in sorted(self._active):
+            req = self._active[slot]
+            if req.expired(now):
+                req.finish(
+                    "expired",
+                    f"deadline ({req.deadline}s) passed mid-decode "
+                    f"after {len(req.tokens)} token(s)",
+                )
+                self.metrics.record_expiry()
+                del self._active[slot]
+                freed.append(slot)
+                continue
+            n_acc = int(acc[slot])
+            acc_active.append(n_acc)
+            req.spec_steps += 1
+            req.spec_accepted_tokens += n_acc
+            committed = [int(t) for t in drafts[slot][:n_acc]]
+            committed.append(int(toks[slot]))
+            for token in committed:
+                self._steps[slot] += 1
+                req.push_token(token)
+                emitted += 1
+                if self._finished(req, token):
+                    freed.append(slot)
+                    break
+        # Host mirrors the device's new_pos formula exactly.
+        self._pos = np.minimum(
+            self._pos + acc.astype(np.int32) + 1, self._caps
+        ).astype(np.int32)
+        self.metrics.record_step(dt, active_before, self.max_batch, emitted)
+        self.metrics.record_spec(acc_active, k)
         return freed

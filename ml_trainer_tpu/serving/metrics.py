@@ -51,6 +51,14 @@ class ServingMetrics:
         self.requests_expired = 0
         self.max_active_slots = 0
         self.queue_depth = 0
+        # Speculative decoding (engine spec mode): acceptance accounting.
+        # One histogram entry per (verify step, active slot); keys are
+        # accepted-draft counts 0..K.
+        self.spec_draft_k = 0
+        self.spec_steps_total = 0
+        self.spec_drafted_tokens = 0
+        self.spec_accepted_tokens = 0
+        self.spec_accept_hist: collections.Counter = collections.Counter()
         self._first_step_at: Optional[float] = None
         self._last_step_at: Optional[float] = None
 
@@ -105,6 +113,17 @@ class ServingMetrics:
         with self._lock:
             self.queue_depth = int(depth)
 
+    def record_spec(self, accepted_counts, draft_k: int) -> None:
+        """One speculative verify step: per-active-slot accepted-draft
+        counts (each slot advanced ``accepted + 1`` tokens)."""
+        with self._lock:
+            self.spec_draft_k = int(draft_k)
+            self.spec_steps_total += 1
+            for a in accepted_counts:
+                self.spec_accept_hist[int(a)] += 1
+                self.spec_drafted_tokens += int(draft_k)
+                self.spec_accepted_tokens += int(a)
+
     # -- reading ---------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -150,6 +169,22 @@ class ServingMetrics:
                 "requests_rejected": self.requests_rejected,
                 "requests_completed": self.requests_completed,
                 "requests_expired": self.requests_expired,
+                "spec_draft_k": self.spec_draft_k,
+                "spec_steps_total": self.spec_steps_total,
+                "spec_drafted_tokens": self.spec_drafted_tokens,
+                "spec_accepted_tokens": self.spec_accepted_tokens,
+                "spec_acceptance_rate": round(
+                    self.spec_accepted_tokens / self.spec_drafted_tokens, 4
+                ) if self.spec_drafted_tokens else 0.0,
+                # Mean tokens committed per slot per verify step (1..K+1).
+                "spec_tokens_per_step": round(
+                    sum((a + 1) * c for a, c in self.spec_accept_hist.items())
+                    / sum(self.spec_accept_hist.values()), 3
+                ) if self.spec_accept_hist else 0.0,
+                "spec_accept_hist": {
+                    str(a): self.spec_accept_hist[a]
+                    for a in sorted(self.spec_accept_hist)
+                },
             }
 
     def log(self, logger=None) -> dict:

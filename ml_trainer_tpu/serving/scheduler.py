@@ -64,6 +64,12 @@ class Request:
     slot: int = -1
     step: int = 0          # tokens sampled so far (the fold_in counter)
     tokens: list = field(default_factory=list)
+    # Speculative decoding (engine spec mode): per-request draft state —
+    # the drafter reads prompt+tokens as its lookup history, and these
+    # counters record how speculation worked out for THIS request
+    # (accepted drafts / verify steps -> its personal acceptance rate).
+    spec_steps: int = 0
+    spec_accepted_tokens: int = 0
     error: Optional[str] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None

@@ -1,0 +1,516 @@
+"""Speculative decoding: draft K tokens cheaply, verify in ONE forward.
+
+The vanilla decode loop (generate.py, serving/engine.py) is latency-bound
+by one full-model forward per token regardless of batch occupancy.
+Speculative decoding breaks that bound: a cheap *drafter* proposes K
+tokens, and the target model scores all K (+1 bonus position) in a
+single forward over a length-``K+1`` token window — the windowed
+cache-append in ``models/layers.py`` writes the window's K/V at each
+row's own dynamic offset, so shapes stay static at fixed K and nothing
+recompiles as requests come and go.
+
+Two draft sources:
+
+* :class:`NgramDrafter` — model-free prompt/history lookup ("prompt
+  lookup decoding"): match the last n-gram of the generated-so-far
+  sequence against everything before it and propose the continuation of
+  the most recent match.  Free to compute, and devastatingly effective
+  on repetitive text (code, cycles, extraction) where greedy decoding
+  revisits its own n-grams.
+* :class:`DraftModelDrafter` — any registry causal LM with the SAME
+  vocabulary (e.g. a tiny GPT-2 config) decoding greedily with its own
+  KV cache; K sequential small-model steps buy one large-model forward.
+
+Acceptance:
+
+* **greedy** (``temperature == 0``): longest-accepted-prefix — draft
+  token ``d_j`` is accepted iff it equals the target's argmax after
+  ``d_1..d_{j-1}``; the first mismatch position takes the target's
+  argmax instead.  The committed stream is therefore *provably
+  byte-identical* to vanilla greedy ``generate()`` for ANY drafts (the
+  drafts only decide how many tokens commit per step, never which).
+* **sampled** (``temperature > 0``): standard speculative rejection
+  sampling against the drafter's point distribution: accept ``d_j``
+  with probability ``p(d_j)`` (target softmax at temperature), else
+  resample from the renormalized residual ``p`` with ``d_j`` masked.
+  The output DISTRIBUTION matches vanilla sampling; the realized draw
+  stream differs from ``generate()``'s per-token ``fold_in`` sequence.
+
+Cache discipline: every compiled program here *sets* the per-row
+``cache_index``/``pos_index`` leaves from an explicit host-owned
+``pos`` vector on entry, so "rolling back" rejected draft positions is
+free — stale K/V beyond ``pos`` is simply never attended (the per-row
+mask is ``arange(L) <= pos + j``) and the next window overwrites it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, _empty_cache
+
+
+def _set_index(cache, pos):
+    """Broadcast the host-owned ``pos`` [B] vector into every per-row
+    index leaf (``cache_index``/``pos_index``, the only 1-D leaves); K/V
+    leaves pass through untouched."""
+    return jax.tree.map(
+        lambda l: pos.astype(l.dtype) if l.ndim == 1 else l, cache
+    )
+
+
+def _widen_cache(cache, b):
+    """Scalar index leaves -> per-row [B] vectors (the slot-indexed
+    layout of models/layers.py; content irrelevant — ``_set_index``
+    overwrites it on every program entry)."""
+    return jax.tree.map(
+        lambda l: jnp.zeros((b,), l.dtype) if l.ndim == 0 else l, cache
+    )
+
+
+class NgramDrafter:
+    """Model-free prompt/history n-gram lookup drafter.
+
+    ``draft_one(history)`` matches the last ``n``-gram (falling back to
+    shorter grams down to ``min_n``) of ``history`` against every
+    earlier position; the continuation after the MOST RECENT match is
+    proposed.  No match -> repeat the last token (the best guess for
+    period-1 cycles, and free to be wrong: a rejected draft costs
+    nothing but its slot in the verify window)."""
+
+    def __init__(self, k: int = 4, n: int = 3, min_n: int = 1):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if not 1 <= min_n <= n:
+            raise ValueError(f"need 1 <= min_n <= n, got n={n} min_n={min_n}")
+        self.k = k
+        self.n = n
+        self.min_n = min_n
+
+    def draft_one(self, history: np.ndarray) -> np.ndarray:
+        hist = np.asarray(history).reshape(-1)
+        m = hist.shape[0]
+        for n in range(min(self.n, m - 1), self.min_n - 1, -1):
+            pat = hist[m - n:]
+            # Windows over hist[:-1]: every start has a continuation.
+            wins = np.lib.stride_tricks.sliding_window_view(hist[:-1], n)
+            hits = np.flatnonzero((wins == pat).all(axis=1))
+            if hits.size:
+                i = int(hits[-1])  # most recent match
+                cont = hist[i + n: i + n + self.k]
+                if cont.size < self.k:
+                    cont = np.concatenate([
+                        cont,
+                        np.full(self.k - cont.size, cont[-1], hist.dtype),
+                    ])
+                return cont.astype(np.int32)
+        return np.full(self.k, hist[-1], np.int32)
+
+    def draft(self, histories) -> np.ndarray:
+        """[B, k] drafts for a batch of 1-D histories."""
+        return np.stack([self.draft_one(h) for h in histories])
+
+
+class DraftModelDrafter:
+    """A small registry causal LM as the draft source.
+
+    The draft model must share the target's vocabulary (checked against
+    the target at use time) and expose the same ``decode``/``max_len``
+    contract.  It decodes greedily with its own KV cache through one
+    compiled K+1-step scan — the extra (K+1)-th step consumes the last
+    draft so the draft cache stays position-aligned with the target's
+    commit state for EVERY acceptance count 0..K."""
+
+    def __init__(self, model, variables: dict):
+        self.model = model
+        self.params = (
+            variables["params"] if "params" in variables else variables
+        )
+
+    def check_compatible(self, target_model) -> None:
+        if self.model.vocab_size != target_model.vocab_size:
+            raise ValueError(
+                "draft model vocab_size "
+                f"({self.model.vocab_size}) must equal the target's "
+                f"({target_model.vocab_size}) — speculative acceptance "
+                "compares token ids across the two models"
+            )
+
+
+# ------------------------------------------------------- compiled programs
+
+
+def build_spec_prefill(model, b: int, greedy: bool):
+    """Batch prefill for the speculative loop: one causal forward over
+    the whole [B, P] prompt, cache widened to per-row index leaves, and
+    the first new token sampled exactly as ``generate()`` samples its
+    t=0 token (argmax when greedy, ``categorical(fold_in(rng, 0))``
+    otherwise)."""
+    dm = model.clone(decode=True)
+    cache_shapes = _cache_shapes(dm, b, jnp.int32)
+
+    @jax.jit
+    def run(params, prompt_ids, temperature, rng):
+        cache = _empty_cache(cache_shapes)
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, prompt_ids,
+            train=False, mutable=["cache"],
+        )
+        cache = _widen_cache(mut["cache"], b)
+        last = logits[:, -1]
+        if greedy:
+            tok = jnp.argmax(last, axis=-1)
+        else:
+            tok = jax.random.categorical(
+                jax.random.fold_in(rng, 0), last / temperature, axis=-1
+            )
+        return cache, tok[:, None].astype(jnp.int32)
+
+    return run
+
+
+def build_verify(model, b: int, s: int):
+    """The compiled verify step at window length ``s`` (= K+1).
+
+    One program serves greedy AND sampled rows (per-row ``temps``
+    select), so the serving engine's ragged traffic shares a single
+    executable at fixed K.  Inputs: the [B, s] window (last committed
+    token + K drafts), the host-owned consumed-token count ``pos`` [B],
+    per-row write caps, temps/rngs/steps for sampling.  Returns the
+    updated cache, per-row accepted-draft counts, the next committed
+    token, and the new ``pos`` (``min(pos + accepted + 1, caps)`` —
+    the caller mirrors the same formula on host)."""
+    dm = model.clone(decode=True)
+
+    # The cache is rebound on every call — donate it so the verify step
+    # updates K/V in place instead of allocating a second copy.
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def verify(params, cache, window, pos, caps, temps, rngs, steps):
+        cache = _set_index(cache, pos)
+        logits, mut = dm.apply(
+            {"params": params, "cache": cache}, window,
+            train=False, mutable=["cache"],
+        )
+        logits = logits.astype(jnp.float32)              # [B, s, V]
+        greedy_next = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        match_g = greedy_next[:, :-1] == window[:, 1:]   # [B, K]
+        # Rejection sampling vs the drafter's point distribution:
+        # accept d_j with prob p_{j-1}(d_j).
+        safe_t = jnp.where(temps > 0, temps, 1.0)[:, None, None]
+        probs = jax.nn.softmax(logits / safe_t, axis=-1)
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1, :], window[:, 1:, None].astype(jnp.int32), axis=-1
+        )[..., 0]                                        # [B, K]
+        keys = jax.vmap(jax.random.fold_in)(rngs, steps)
+        u = jax.vmap(
+            lambda k_: jax.random.uniform(jax.random.fold_in(k_, 1), (s - 1,))
+        )(keys)
+        match = jnp.where((temps > 0)[:, None], u < p_draft, match_g)
+        # Longest accepted prefix: #leading True.
+        accepted = jnp.sum(
+            jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1
+        )                                                # [B] in [0, K]
+        # Next token at position `accepted`: greedy rows take argmax;
+        # sampled rows draw from the residual (rejected draft masked,
+        # renormalized) — or the untouched bonus distribution when all
+        # K drafts were accepted.
+        p_next = jnp.take_along_axis(
+            probs, accepted[:, None, None], axis=1
+        )[:, 0, :]                                       # [B, V]
+        rejected = jnp.take_along_axis(
+            window, jnp.minimum(accepted + 1, s - 1)[:, None], axis=1
+        )[:, 0]
+        mask_rej = accepted < (s - 1)
+        p_resid = jnp.where(
+            jax.nn.one_hot(rejected, probs.shape[-1], dtype=bool)
+            & mask_rej[:, None],
+            0.0, p_next,
+        )
+        p_resid = p_resid / jnp.maximum(
+            p_resid.sum(axis=-1, keepdims=True), 1e-20
+        )
+        samp_next = jax.vmap(
+            lambda k_, pr: jax.random.categorical(
+                jax.random.fold_in(k_, 2), jnp.log(jnp.maximum(pr, 1e-20))
+            )
+        )(keys, p_resid)
+        greedy_pick = jnp.take_along_axis(
+            greedy_next, accepted[:, None], axis=1
+        )[:, 0]
+        nxt = jnp.where(temps > 0, samp_next, greedy_pick).astype(jnp.int32)
+        new_pos = jnp.minimum(pos + accepted + 1, caps)
+        return _set_index(mut["cache"], new_pos), accepted, nxt[:, None], new_pos
+
+    return verify
+
+
+def build_draft_scan(draft_model, b: int, k: int):
+    """K+1 greedy single-token draft-model steps as one compiled scan.
+
+    Step j consumes the previous token and emits draft ``d_j``; the
+    final (K+1)-th step consumes ``d_K`` purely to land its K/V in the
+    draft cache, so the draft cache covers the full verify window and
+    the host's single ``pos`` vector stays valid for both models at any
+    acceptance count."""
+    dm = draft_model.clone(decode=True)
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def run(params, cache, tok, pos):
+        cache = _set_index(cache, pos)
+
+        def step(carry, _):
+            cache, tok = carry
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache}, tok,
+                train=False, mutable=["cache"],
+            )
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return (mut["cache"], nxt[:, None]), nxt
+
+        (cache, _), drafts = jax.lax.scan(
+            step, (cache, tok), None, length=k + 1
+        )
+        return cache, jnp.moveaxis(drafts[:k], 0, 1)     # [B, k]
+
+    return run
+
+
+def _program(key, build):
+    run = _COMPILED.get(key)
+    if run is None:
+        run = build()
+        _COMPILED[key] = run
+    return run
+
+
+# ------------------------------------------------------------- batch API
+
+
+def speculative_generate(
+    model,
+    variables: dict,
+    prompt_ids,
+    max_new_tokens: int,
+    draft_k: int = 4,
+    drafter="ngram",
+    draft_variables: Optional[dict] = None,
+    temperature: float = 0.0,
+    rng: Optional[jax.Array] = None,
+    eos_token_id: Optional[int] = None,
+    pad_token_id: int = 0,
+    ngram: int = 3,
+    return_stats: bool = False,
+):
+    """``generate()`` with speculative decoding — same output contract.
+
+    ``drafter`` is ``"ngram"`` (prompt/history lookup), an
+    :class:`NgramDrafter`, a :class:`DraftModelDrafter`, or a registry
+    model instance (then ``draft_variables`` supplies its params).
+    Greedy output (``temperature == 0``) is byte-identical to
+    ``generate()``; sampled output follows the same distribution via
+    rejection sampling but draws a different stream.  ``top_k``/
+    ``top_p`` are not supported here — use vanilla ``generate()``.
+
+    Returns [B, P + max_new_tokens] ids, plus a stats dict
+    (``accept_hist``, ``acceptance_rate``, ``verify_steps``) when
+    ``return_stats``.
+    """
+    params = variables["params"] if "params" in variables else variables
+    prompt_ids = jnp.asarray(prompt_ids)
+    b, p = prompt_ids.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if draft_k < 1:
+        raise ValueError(f"draft_k must be >= 1, got {draft_k}")
+    if p + max_new_tokens + draft_k > model.max_len:
+        raise ValueError(
+            f"prompt ({p}) + new tokens ({max_new_tokens}) + draft_k "
+            f"({draft_k}) exceeds max_len ({model.max_len}); the verify "
+            "window needs draft_k tokens of cache slack — reduce draft_k "
+            "or max_new_tokens"
+        )
+    if eos_token_id is not None and not 0 <= eos_token_id < model.vocab_size:
+        raise ValueError(
+            f"eos_token_id must be in [0, vocab_size={model.vocab_size}), "
+            f"got {eos_token_id}"
+        )
+    if max_new_tokens == 0:
+        return (prompt_ids, _empty_stats(draft_k)) if return_stats \
+            else prompt_ids
+    greedy = temperature == 0.0
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # -- drafter normalization ------------------------------------------
+    draft_model = None
+    if drafter == "ngram":
+        drafter = NgramDrafter(k=draft_k, n=ngram)
+    elif isinstance(drafter, NgramDrafter):
+        if drafter.k != draft_k:
+            raise ValueError(
+                f"drafter.k ({drafter.k}) != draft_k ({draft_k})"
+            )
+    elif isinstance(drafter, DraftModelDrafter):
+        draft_model = drafter
+    elif hasattr(drafter, "max_len"):  # a registry model instance
+        if draft_variables is None:
+            raise ValueError(
+                "a draft model needs draft_variables (its params)"
+            )
+        draft_model = DraftModelDrafter(drafter, draft_variables)
+    else:
+        raise ValueError(
+            f"drafter must be 'ngram', an NgramDrafter, a "
+            f"DraftModelDrafter or a registry model, got {drafter!r}"
+        )
+    if draft_model is not None:
+        draft_model.check_compatible(model)
+        if p + max_new_tokens + draft_k > draft_model.model.max_len:
+            raise ValueError(
+                "the draft model's max_len "
+                f"({draft_model.model.max_len}) is too short for this "
+                f"request (needs {p + max_new_tokens + draft_k})"
+            )
+
+    s = draft_k + 1
+    prefill = _program(
+        ("spec_prefill", model, b, p, greedy),
+        lambda: build_spec_prefill(model, b, greedy),
+    )
+    verify = _program(
+        ("spec_verify", model, b, s), lambda: build_verify(model, b, s)
+    )
+    temp = jnp.asarray(temperature, jnp.float32)
+    cache, tok = prefill(params, prompt_ids, temp, rng)
+
+    if draft_model is not None:
+        d_prefill = _program(
+            ("spec_prefill", draft_model.model, b, p, True),
+            lambda: build_spec_prefill(draft_model.model, b, True),
+        )
+        d_scan = _program(
+            ("spec_draft", draft_model.model, b, draft_k),
+            lambda: build_draft_scan(draft_model.model, b, draft_k),
+        )
+        d_cache, _ = d_prefill(draft_model.params, prompt_ids, temp, rng)
+
+    # -- host state ------------------------------------------------------
+    out = np.zeros((b, max_new_tokens), np.int32)
+    counts = np.zeros(b, np.int64)          # committed tokens per row
+    done = np.zeros(b, bool)                # rows that emitted EOS
+    pos = np.full(b, p, np.int32)           # consumed tokens per row
+    caps = np.full(b, p + max_new_tokens - 1, np.int32)
+    temps = np.full(b, temperature, np.float32)
+    # Per-row keys (fold the row index): rows must draw INDEPENDENT
+    # accept/resample noise — a shared key would correlate acceptance
+    # across the batch.
+    rngs = np.stack([
+        np.asarray(jax.random.fold_in(rng, i), np.uint32).reshape(-1)[:2]
+        for i in range(b)
+    ])
+    steps = np.zeros(b, np.int32)
+    prompt_np = np.asarray(prompt_ids)
+    hist = np.zeros((b, p + max_new_tokens), np.int32)
+    hist[:, :p] = prompt_np
+    accept_hist = np.zeros(s, np.int64)
+    verify_steps = 0
+
+    tok_h = np.asarray(tok)[:, 0]
+    _commit_token(tok_h, out, counts, done, hist, p,
+                  eos_token_id, pad_token_id, max_new_tokens)
+
+    while counts.min() < max_new_tokens:
+        if draft_model is not None:
+            d_cache, drafts_dev = d_scan(
+                draft_model.params, d_cache, tok, jnp.asarray(pos)
+            )
+            drafts = np.asarray(drafts_dev)
+        else:
+            drafts = drafter.draft(
+                [hist[i, : p + int(counts[i])] for i in range(b)]
+            )
+        window = jnp.concatenate(
+            [tok, jnp.asarray(drafts, jnp.int32)], axis=1
+        )
+        cache, accepted, tok, _ = verify(
+            params, cache, window, jnp.asarray(pos), jnp.asarray(caps),
+            jnp.asarray(temps), jnp.asarray(rngs), jnp.asarray(steps),
+        )
+        acc = np.asarray(accepted)
+        tok_h = np.asarray(tok)[:, 0]
+        verify_steps += 1
+        live = counts < max_new_tokens
+        np.add.at(accept_hist, acc[live], 1)
+        for j in range(draft_k + 1):
+            # Commit accepted drafts then the verify token, row-wise.
+            sel = acc >= j + 1
+            row_tok = np.where(sel, drafts[:, j] if j < draft_k else 0,
+                               tok_h)
+            mask = (acc >= j) & live
+            _commit_token(row_tok, out, counts, done, hist, p,
+                          eos_token_id, pad_token_id, max_new_tokens,
+                          rows=mask)
+        pos = np.minimum(pos + acc + 1, caps).astype(np.int32)
+        steps = steps + acc.astype(np.int32) + 1
+
+    full = np.concatenate([prompt_np, out], axis=1)
+    result = jnp.asarray(full, prompt_ids.dtype)
+    if return_stats:
+        # One histogram entry per (step, live row): drafted counts K per
+        # entry, not K per step — the batch dimension drafts too.
+        drafted = int(accept_hist.sum()) * draft_k
+        accepted_total = int(
+            (accept_hist * np.arange(s)).sum()
+        )
+        return result, {
+            "draft_k": draft_k,
+            "verify_steps": verify_steps,
+            "accept_hist": accept_hist.tolist(),
+            "drafted_tokens": drafted,
+            "accepted_tokens": accepted_total,
+            "acceptance_rate": (
+                accepted_total / drafted if drafted else 0.0
+            ),
+            "tokens_per_step": (
+                float((accept_hist * (np.arange(s) + 1)).sum()
+                      / accept_hist.sum())
+                if accept_hist.sum() else 0.0
+            ),
+        }
+    return result
+
+
+def _empty_stats(draft_k: int) -> dict:
+    return {
+        "draft_k": draft_k, "verify_steps": 0,
+        "accept_hist": [0] * (draft_k + 1), "drafted_tokens": 0,
+        "accepted_tokens": 0, "acceptance_rate": 0.0,
+        "tokens_per_step": 0.0,
+    }
+
+
+def _commit_token(row_tok, out, counts, done, hist, p,
+                  eos_token_id, pad_token_id, max_new_tokens, rows=None):
+    """Append one token per selected row to the output/history buffers,
+    honoring EOS -> pad tails (generate()'s masking semantics) and the
+    per-row budget."""
+    b = out.shape[0]
+    for i in range(b):
+        if rows is not None and not rows[i]:
+            continue
+        c = int(counts[i])
+        if c >= max_new_tokens:
+            continue
+        t = int(row_tok[i])
+        if done[i] and eos_token_id is not None:
+            t = pad_token_id
+        out[i, c] = t
+        hist[i, p + c] = t
+        counts[i] = c + 1
+        if eos_token_id is not None and not done[i] and t == eos_token_id:
+            done[i] = True

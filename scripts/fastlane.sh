@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# The tier-1 verify gate, EXACTLY as ROADMAP.md specifies it — one
+# committed wrapper so the builder and the reviewer run the identical
+# command (pipefail, CPU pinned, fast lane only, DOTS_PASSED count).
+#
+#   ./scripts/fastlane.sh            # from the repo root
+#
+# Exits with pytest's status; prints DOTS_PASSED=<n> as the last line.
+set -o pipefail
+cd "$(dirname "$0")/.." || exit 1
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+exit $rc

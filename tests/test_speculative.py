@@ -1,0 +1,392 @@
+"""Speculative decoding (ml_trainer_tpu/speculative.py + serving spec
+mode).
+
+The load-bearing property: greedy speculative output is BYTE-IDENTICAL
+to vanilla ``generate()`` for any draft source and any K — the drafts
+only decide how many tokens commit per verify step, never which.
+Around that core: the windowed cache-append at unaligned offsets, the
+n-gram drafter's lookup rules, rejection sampling at temperature > 0,
+serving-engine spec mode with mid-stream joins, acceptance metrics, and
+the no-recompilation guarantee at fixed K.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, generate
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.speculative import (
+    DraftModelDrafter,
+    NgramDrafter,
+    speculative_generate,
+)
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = get_model("gpt2_tiny", max_len=128)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables
+
+
+@pytest.fixture(scope="module")
+def draft_and_vars():
+    # Same 1024 vocab as gpt2_tiny, quarter the width and depth.
+    model = get_model("gpt2_tiny", max_len=128, depth=1, embed_dim=64,
+                      num_heads=2)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(1)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables
+
+
+def _prompt(seed, b=2, p=7):
+    return jnp.asarray(
+        np.random.default_rng(seed).integers(0, 1024, (b, p)), jnp.int32
+    )
+
+
+# ------------------------------------------------- windowed cache-append
+def test_windowed_cache_append_at_unaligned_offsets(model_and_vars):
+    """A multi-token window through the per-row decode path at an
+    UNALIGNED dynamic offset must reproduce the full causal forward's
+    logits exactly, and land its K/V at exactly positions
+    [offset, offset+window)."""
+    model, variables = model_and_vars
+    params = variables["params"]
+    dm = model.clone(decode=True)
+    ids = _prompt(0, b=2, p=11)
+    shapes = _cache_shapes(dm, 2, jnp.int32)
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+    # Prefill 7 tokens (scalar path), widen index leaves to per-row.
+    _, mut = dm.apply(
+        {"params": params, "cache": cache}, ids[:, :7],
+        train=False, mutable=["cache"],
+    )
+    cache = jax.tree.map(
+        lambda l: jnp.full((2,), 7, jnp.int32) if l.ndim == 0 else l,
+        mut["cache"],
+    )
+    # Window of 4 tokens at the unaligned offset 7.
+    logits_w, mut2 = dm.apply(
+        {"params": params, "cache": cache}, ids[:, 7:11],
+        train=False, mutable=["cache"],
+    )
+    ref = model.apply({"params": params}, ids, train=False)
+    np.testing.assert_allclose(
+        np.asarray(logits_w), np.asarray(ref[:, 7:11]), rtol=2e-5,
+        atol=2e-5,
+    )
+    # K/V landed at positions 7..10 and nowhere else; indices advanced.
+    for leaf in jax.tree.leaves(mut2["cache"]):
+        if leaf.ndim == 1:
+            np.testing.assert_array_equal(np.asarray(leaf), [11, 11])
+        else:
+            assert not np.allclose(np.asarray(leaf[:, :, 7:11]), 0.0)
+            np.testing.assert_array_equal(
+                np.asarray(leaf[:, :, 11:]), 0.0
+            )
+
+
+def test_windowed_append_per_row_distinct_offsets(model_and_vars):
+    """Rows sitting at DIFFERENT positions write their windows at their
+    own offsets — each row's logits match its own-length reference."""
+    model, variables = model_and_vars
+    params = variables["params"]
+    dm = model.clone(decode=True)
+    rng = np.random.default_rng(3)
+    row0 = jnp.asarray(rng.integers(0, 1024, 9), jnp.int32)   # 5 + 4
+    row1 = jnp.asarray(rng.integers(0, 1024, 7), jnp.int32)   # 3 + 4
+    shapes = _cache_shapes(dm, 1, jnp.int32)
+
+    def prefill(row, p):
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        _, mut = dm.apply(
+            {"params": params, "cache": cache}, row[None, :p],
+            train=False, mutable=["cache"],
+        )
+        return mut["cache"]
+
+    c0, c1 = prefill(row0, 5), prefill(row1, 3)
+    # Stack the two batch-1 caches into one 2-row slot cache with
+    # per-row indices (5, 3).
+    cache = jax.tree.map(
+        lambda a, b: (
+            jnp.concatenate([a, b]) if a.ndim else
+            jnp.asarray([a, b], jnp.int32)
+        ),
+        c0, c1,
+    )
+    window = jnp.stack([row0[5:9], row1[3:7]])
+    logits_w, _ = dm.apply(
+        {"params": params, "cache": cache}, window,
+        train=False, mutable=["cache"],
+    )
+    ref0 = model.apply({"params": params}, row0[None], train=False)
+    ref1 = model.apply({"params": params}, row1[None], train=False)
+    np.testing.assert_allclose(
+        np.asarray(logits_w[0]), np.asarray(ref0[0, 5:9]),
+        rtol=2e-5, atol=2e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_w[1]), np.asarray(ref1[0, 3:7]),
+        rtol=2e-5, atol=2e-5,
+    )
+
+
+# ------------------------------------------------------- n-gram drafter
+def test_ngram_drafter_lookup_rules():
+    d = NgramDrafter(k=3, n=2)
+    # Last bigram (7, 8) matched earlier; continuation 9, 1, 2 follows.
+    hist = np.asarray([7, 8, 9, 1, 2, 3, 7, 8], np.int32)
+    np.testing.assert_array_equal(d.draft_one(hist), [9, 1, 2])
+    # Most RECENT match wins over the first.
+    hist2 = np.asarray([5, 6, 1, 5, 6, 2, 5, 6], np.int32)
+    assert d.draft_one(hist2)[0] == 2
+    # No match at any n: repeat the last token.
+    hist3 = np.asarray([1, 2, 3, 4], np.int32)
+    np.testing.assert_array_equal(d.draft_one(hist3), [4, 4, 4])
+    # Short continuation pads with its own last token.
+    hist4 = np.asarray([5, 4, 5, 4], np.int32)
+    np.testing.assert_array_equal(d.draft_one(hist4), [5, 4, 4])
+
+
+def test_ngram_drafter_validates():
+    with pytest.raises(ValueError, match="k must be"):
+        NgramDrafter(k=0)
+    with pytest.raises(ValueError, match="min_n"):
+        NgramDrafter(k=2, n=2, min_n=3)
+
+
+# -------------------------------------------- greedy output identity
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_greedy_spec_byte_identical_lookup(model_and_vars, k):
+    """The acceptance property, lookup drafter: greedy speculative ==
+    vanilla generate, byte for byte, K ∈ {2, 4, 8}."""
+    model, variables = model_and_vars
+    ids = _prompt(1, b=3)
+    ref = np.asarray(generate(model, variables, ids, 40))
+    out = speculative_generate(model, variables, ids, 40, draft_k=k)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+@pytest.mark.parametrize("k", [2, 4, 8])
+def test_greedy_spec_byte_identical_draft_model(
+    model_and_vars, draft_and_vars, k
+):
+    """Same property, small-draft-model drafter."""
+    model, variables = model_and_vars
+    dmod, dvars = draft_and_vars
+    ids = _prompt(2, b=2)
+    ref = np.asarray(generate(model, variables, ids, 32))
+    out = speculative_generate(
+        model, variables, ids, 32, draft_k=k, drafter=dmod,
+        draft_variables=dvars,
+    )
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_spec_generate_via_generate_kwarg(model_and_vars):
+    """generate(spec_k=...) routes through the speculative path and
+    keeps the output contract."""
+    model, variables = model_and_vars
+    ids = _prompt(4)
+    ref = np.asarray(generate(model, variables, ids, 24))
+    out = generate(model, variables, ids, 24, spec_k=4)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+    with pytest.raises(ValueError, match="top_k"):
+        generate(model, variables, ids, 8, spec_k=4, top_k=5)
+
+
+def test_spec_eos_matches_generate(model_and_vars):
+    """EOS semantics under speculation: the row stops at EOS and pads
+    the tail exactly like generate()."""
+    model, variables = model_and_vars
+    ids = _prompt(5, b=2)
+    base = np.asarray(generate(model, variables, ids, 16))
+    eos = int(base[0, ids.shape[1] + 2])  # a token a few steps in
+    ref = np.asarray(generate(model, variables, ids, 16,
+                              eos_token_id=eos, pad_token_id=99))
+    out = speculative_generate(model, variables, ids, 16, draft_k=4,
+                               eos_token_id=eos, pad_token_id=99)
+    np.testing.assert_array_equal(np.asarray(out), ref)
+
+
+def test_spec_stats_and_acceptance_accounting(model_and_vars):
+    model, variables = model_and_vars
+    ids = _prompt(6)
+    out, stats = speculative_generate(
+        model, variables, ids, 30, draft_k=4, return_stats=True
+    )
+    assert out.shape == (2, 7 + 30)
+    assert stats["verify_steps"] > 0
+    assert len(stats["accept_hist"]) == 5
+    assert stats["drafted_tokens"] == sum(stats["accept_hist"]) * 4
+    assert 0.0 <= stats["acceptance_rate"] <= 1.0
+    assert 1.0 <= stats["tokens_per_step"] <= 5.0
+
+
+def test_spec_sampled_runs_and_in_range(model_and_vars):
+    """temperature > 0 uses rejection sampling: same distribution, not
+    the same stream — assert shape/vocab-range and seed determinism."""
+    model, variables = model_and_vars
+    ids = _prompt(7)
+    a = np.asarray(speculative_generate(
+        model, variables, ids, 20, draft_k=4, temperature=0.8,
+        rng=jax.random.PRNGKey(5),
+    ))
+    b = np.asarray(speculative_generate(
+        model, variables, ids, 20, draft_k=4, temperature=0.8,
+        rng=jax.random.PRNGKey(5),
+    ))
+    np.testing.assert_array_equal(a, b)  # same seed, same stream
+    assert a.shape == (2, 27) and a.min() >= 0 and a.max() < 1024
+    np.testing.assert_array_equal(a[:, :7], np.asarray(ids))
+
+
+def test_spec_validates_args(model_and_vars, draft_and_vars):
+    model, variables = model_and_vars
+    ids = _prompt(8)
+    with pytest.raises(ValueError, match="draft_k"):
+        speculative_generate(model, variables, ids, 8, draft_k=0)
+    with pytest.raises(ValueError, match="max_len"):
+        speculative_generate(model, variables, ids, 10_000, draft_k=4)
+    with pytest.raises(ValueError, match="draft_variables"):
+        speculative_generate(model, variables, ids, 8, draft_k=4,
+                             drafter=model)
+    # Vocab-incompatible draft model is rejected up front.
+    wrong = get_model("gpt2_tiny", max_len=128, vocab_size=512)
+    wvars = wrong.init({"params": jax.random.PRNGKey(2)},
+                       np.zeros((1, 4), np.int32), train=False)
+    with pytest.raises(ValueError, match="vocab_size"):
+        speculative_generate(model, variables, ids, 8, draft_k=4,
+                             drafter=wrong, draft_variables=wvars)
+
+
+def test_registry_draft_pairing():
+    from ml_trainer_tpu.models.registry import suggested_draft
+
+    target = get_model("gpt2_mini")
+    draft = suggested_draft("gpt2_mini")
+    assert draft.vocab_size == target.vocab_size
+    DraftModelDrafter(draft, {"params": {}}).check_compatible(target)
+    with pytest.raises(ValueError, match="n-gram"):
+        suggested_draft("bert_tiny")
+
+
+# --------------------------------------------------- serving spec mode
+def test_serving_spec_mid_stream_join_byte_identical(model_and_vars):
+    """The serving acceptance scenario: spec mode, requests joining a
+    RUNNING speculative decode at arbitrary boundaries — greedy rows
+    byte-identical to standalone generate(), acceptance counters live."""
+    from ml_trainer_tpu.serving import Server
+
+    model, variables = model_and_vars
+    pA = np.asarray(np.random.default_rng(20).integers(0, 1024, 5),
+                    np.int32)
+    pB = np.asarray(np.random.default_rng(21).integers(0, 1024, 3),
+                    np.int32)
+    pC = np.asarray(np.random.default_rng(22).integers(0, 1024, 6),
+                    np.int32)
+    refA = np.asarray(generate(model, variables, pA[None], 24))[0]
+    refB = np.asarray(generate(model, variables, pB[None], 9))[0]
+    refC = np.asarray(generate(model, variables, pC[None], 7))[0]
+    with Server(model, variables, max_batch=3, spec_k=4) as server:
+        sA = server.submit(pA, 24)
+        next(iter(sA))  # A is actively decoding when B and C join
+        sB = server.submit(pB, 9)
+        sC = server.submit(pC, 7)
+        outA = sA.result(timeout=120)
+        outB = sB.result(timeout=120)
+        outC = sC.result(timeout=120)
+        snap = server.metrics.snapshot()
+    np.testing.assert_array_equal(outA, refA)
+    np.testing.assert_array_equal(outB, refB)
+    np.testing.assert_array_equal(outC, refC)
+    assert snap["max_active_slots"] >= 2
+    assert snap["spec_steps_total"] > 0
+    assert snap["spec_drafted_tokens"] > 0
+    assert sum(snap["spec_accept_hist"].values()) > 0
+    assert 0.0 <= snap["spec_acceptance_rate"] <= 1.0
+    assert snap["spec_tokens_per_step"] >= 1.0
+
+
+def test_serving_spec_draft_model_and_slot_reuse(
+    model_and_vars, draft_and_vars
+):
+    """Draft-model drafter in the engine: more requests than slots, so
+    slots recycle mid-run; every output byte-identical."""
+    from ml_trainer_tpu.serving import Server
+
+    model, variables = model_and_vars
+    dmod, dvars = draft_and_vars
+    prompts = [
+        np.asarray(np.random.default_rng(30 + i).integers(0, 1024, 3 + i),
+                   np.int32)
+        for i in range(5)
+    ]
+    refs = [
+        np.asarray(generate(model, variables, p[None], 8 + i))[0]
+        for i, p in enumerate(prompts)
+    ]
+    with Server(model, variables, max_batch=2, spec_k=3,
+                drafter=dmod, draft_variables=dvars) as server:
+        streams = [server.submit(p, 8 + i)
+                   for i, p in enumerate(prompts)]
+        outs = [s.result(timeout=120) for s in streams]
+    for out, ref in zip(outs, refs):
+        np.testing.assert_array_equal(out, ref)
+
+
+def test_serving_spec_no_recompilation_across_ragged_traffic(
+    model_and_vars
+):
+    """The static-shape guarantee: after a warm-up wave, a second wave
+    of DIFFERENT ragged prompts/budgets at the same fixed K compiles
+    NOTHING new — the compiled-program count stays constant."""
+    from ml_trainer_tpu.serving import Server
+
+    model, variables = model_and_vars
+
+    def wave(server, seed0):
+        for i in range(6):
+            p = np.asarray(
+                np.random.default_rng(seed0 + i).integers(
+                    0, 1024, 3 + (i % 4)
+                ),
+                np.int32,
+            )
+            server.complete(p, 4 + (i % 5), timeout=120)
+
+    with Server(model, variables, max_batch=2, spec_k=4) as server:
+        wave(server, 100)
+        n_warm = len(_COMPILED._data)
+        wave(server, 200)
+        n_after = len(_COMPILED._data)
+    assert n_after == n_warm, (
+        f"ragged spec traffic at fixed K compiled "
+        f"{n_after - n_warm} new program(s)"
+    )
+
+
+def test_serving_spec_request_counters_and_validation(model_and_vars):
+    from ml_trainer_tpu.serving import Server
+
+    model, variables = model_and_vars
+    p = np.asarray(np.random.default_rng(40).integers(0, 1024, 4),
+                   np.int32)
+    with Server(model, variables, max_batch=1, spec_k=4) as server:
+        stream = server.submit(p, 12)
+        stream.result(timeout=120)
+        req = stream.request
+        assert req.spec_steps > 0
+        assert req.spec_accepted_tokens >= 0
+        # max_len guard now includes the spec_k slack.
+        with pytest.raises(ValueError, match="spec_k"):
+            server.submit(p, 128 - 4)

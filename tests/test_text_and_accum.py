@@ -20,13 +20,31 @@ pytestmark = pytest.mark.slow
 
 
 # ------------------------------------------------------------------- text
-def test_tokenize_texts_offline_fallback():
+def test_tokenize_texts_defaults_to_in_tree_fixture_vocab():
+    """With no vocab configured anywhere, tokenize_texts now encodes
+    with the REAL in-tree tokenizer over the repo's fixture vocabs (the
+    hash stand-in is an explicit opt-in, VERDICT order #6)."""
+    from ml_trainer_tpu.data.tokenizers import (
+        fixture_vocab_dir,
+        load_tokenizer,
+    )
+
     ids, mask = tokenize_texts(["a great movie", "terrible"], max_len=16)
     assert ids.shape == (2, 16) and mask.shape == (2, 16)
-    assert ids[0, 0] == 1  # [CLS]
-    assert mask[0].sum() == 5  # cls + 3 words + sep
+    tok = load_tokenizer(fixture_vocab_dir())
+    ref = tok.encode("a great movie")
+    assert list(ids[0][: len(ref)]) == ref
+    assert mask[0].sum() == len(ref)
     ids2, _ = tokenize_texts(["a great movie", "terrible"], max_len=16)
     np.testing.assert_array_equal(ids, ids2)  # deterministic
+
+
+def test_tokenize_texts_hash_is_explicit_opt_in():
+    ids, mask = tokenize_texts(
+        ["a great movie", "terrible"], max_len=16, tokenizer="hash"
+    )
+    assert ids[0, 0] == 1  # [CLS]-style framing
+    assert mask[0].sum() == 5  # cls + 3 words + sep
 
 
 def test_tokenized_dataset_and_bert_finetune_flow(tmp_path):
