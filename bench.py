@@ -582,6 +582,10 @@ def _compiled_flops(compiled) -> float | None:
 # kwargs are built lazily (jnp.bfloat16 needs jax at call time, and keeping
 # everything in one table means a new model cannot be half-registered).
 EXTENDED_CONFIGS = {
+    # The parity workload's model as a --one row (CPU-cheap): the
+    # resilience acceptance gate compares `--one mlmodel` across commits
+    # to prove the nonfinite guard adds no measurable step cost.
+    "mlmodel": ((32, 32, 32, 3), "image", lambda: dict()),
     "resnet50": ((32, 224, 224, 3), "image", lambda: dict(dtype=jnp.bfloat16)),
     "vit_b16": ((32, 224, 224, 3), "image",
                 lambda: dict(num_classes=1000, dtype=jnp.bfloat16)),
@@ -718,6 +722,106 @@ def bench_one_model(name: str, batch_size: int | None = None) -> dict:
     }
 
 
+def bench_chaos(size=2048, batch_size=32, save_every=8, preempt_step=41,
+                epochs=1):
+    """Chaos leg: the measurable cost of resilience (CPU-safe, tiny model).
+
+    Three numbers a preemptible-fleet operator budgets around:
+
+    * ``ckpt_overhead_pct`` — wall-clock overhead of step-granular
+      checkpoints (``save_every_steps``) vs the same epoch without them
+      (the async writer should hide most of the I/O);
+    * ``steps_lost_on_preempt`` — training steps between the last
+      committed step checkpoint and the preemption point (bounded by
+      ``save_every_steps - 1``);
+    * ``time_to_recover_secs`` — wall clock for ``fit(resume=True)`` to
+      restore the emergency checkpoint and finish the interrupted epoch.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    from ml_trainer_tpu import Trainer, MLModel
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+    from ml_trainer_tpu.resilience import faults
+    from ml_trainer_tpu import checkpoint as ckpt
+
+    def fresh(model_dir, **kw):
+        return Trainer(
+            MLModel(),
+            datasets=(SyntheticCIFAR10(size=size, seed=0),
+                      SyntheticCIFAR10(size=256, seed=1)),
+            epochs=epochs, batch_size=batch_size, model_dir=model_dir,
+            metric=None, lr=0.01, **kw,
+        )
+
+    dirs = [tempfile.mkdtemp(prefix="bench_chaos_") for _ in range(4)]
+    try:
+        # Warmup run: pays one-time costs (first-touch numpy/XLA paths)
+        # so the base-vs-checkpointed comparison is order-independent.
+        fresh(dirs[3]).fit()
+        # 1. checkpoint-save overhead: same epoch with/without step saves.
+        t0 = time.perf_counter()
+        fresh(dirs[0]).fit()
+        base_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        fresh(dirs[1], save_every_steps=save_every).fit()
+        ckpt.wait_for_checkpoints()
+        ckpt_s = time.perf_counter() - t0
+        overhead_pct = (ckpt_s / base_s - 1.0) * 100.0
+        print(f"# chaos ckpt overhead: {base_s:.2f}s -> {ckpt_s:.2f}s "
+              f"({overhead_pct:+.1f}% with save_every_steps={save_every})",
+              flush=True)
+
+        # 2. preemption: inject at a step between two step-checkpoints.
+        with faults.injected(f"preempt@step={preempt_step}"):
+            t = fresh(dirs[2], save_every_steps=save_every)
+            t.fit()
+        assert t.preempted, "preempt fault did not fire"
+        latest = ckpt.latest_valid_checkpoint(
+            os.path.join(dirs[2], "checkpoints")
+        )
+        _, hist, _ = ckpt.restore_checkpoint(
+            latest, ckpt.fetch_to_host(t.state)
+        )
+        saved_step = hist.get("mid_epoch", {}).get("batches_done", 0)
+        # The emergency save checkpoints the preemption step itself, so
+        # steps re-trained on resume measure the NO-emergency floor: the
+        # cadence gap a hard-kill (no clean exit) would lose.
+        cadence_lost = preempt_step - (
+            preempt_step // save_every
+        ) * save_every
+        print(f"# chaos preempt at step {preempt_step}: emergency save at "
+              f"batch {saved_step}, steps lost 0 (clean exit) / "
+              f"{cadence_lost} (hard kill, cadence {save_every})",
+              flush=True)
+
+        # 3. time-to-recover: resume and finish the interrupted epoch.
+        t0 = time.perf_counter()
+        r = fresh(dirs[2], save_every_steps=save_every)
+        r.fit(resume=True)
+        recover_s = time.perf_counter() - t0
+        print(f"# chaos time-to-recover: {recover_s:.2f}s "
+              f"(restore + {size // batch_size - saved_step} remaining "
+              "step(s) + validation)", flush=True)
+        return {
+            "ckpt_overhead_pct": round(overhead_pct, 1),
+            "base_epoch_secs": round(base_s, 2),
+            "ckpt_epoch_secs": round(ckpt_s, 2),
+            "save_every_steps": save_every,
+            "preempt_step": preempt_step,
+            "emergency_saved_at_batch": saved_step,
+            "steps_lost_clean_exit": 0,
+            "steps_lost_hard_kill": cadence_lost,
+            "time_to_recover_secs": round(recover_s, 2),
+            "resumed_epochs": r.history["epochs"],
+            "backend": jax.default_backend(),
+        }
+    finally:
+        for d in dirs:
+            shutil.rmtree(d, ignore_errors=True)
+
+
 def bench_extended():
     """North-star table, one model per SUBPROCESS so a tunnel hang in any
     single model costs its per-model timeout, not the whole table (round
@@ -826,6 +930,10 @@ def main():
                         help="run only the pjit dispatch microbenchmark: "
                         "per-call host overhead of the compiled train and "
                         "decode steps (CPU-safe)")
+    parser.add_argument("--chaos", action="store_true",
+                        help="run only the chaos/recovery benchmark: "
+                        "step-checkpoint overhead, steps lost on "
+                        "preemption, time-to-recover (MLModel; CPU-safe)")
     parser.add_argument("--serve", action="store_true",
                         help="run only the serving benchmark: the "
                         "continuous-batching engine vs a generate_ragged "
@@ -877,6 +985,10 @@ def main():
         # Host-side only: measures the input pipeline, touches no device,
         # so it is safe (and meaningful) while the TPU tunnel is down.
         bench_loaders()
+        return
+    if args.chaos:
+        # Recovery-overhead leg; tiny model, any backend.
+        print(json.dumps({"chaos": bench_chaos()}))
         return
     if args.serve:
         # Tiny model; meaningful on any backend.  One JSON line for the

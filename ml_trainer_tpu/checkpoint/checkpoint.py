@@ -29,12 +29,14 @@ remain readable.
 from __future__ import annotations
 
 import copy
+import io
 import json
 import os
 import pickle
 import re
 import shutil
 import threading
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from typing import Any, List, Optional, Tuple
 
@@ -45,7 +47,13 @@ from flax import serialization
 MODEL_FILE = "model.msgpack"
 CHECKPOINT_PREFIX = "checkpoint_"
 MANIFEST = "manifest.json"
+CORRUPT_SUFFIX = ".corrupt"
 _CKPT_RE = re.compile(rf"^{CHECKPOINT_PREFIX}(\d+)(\.pkl)?$")
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint failed integrity verification (missing leaf files,
+    unreadable manifest, or a CRC32 mismatch against the manifest)."""
 
 # One writer thread: checkpoint writes are ordered (epoch N lands before
 # N+1) and never overlap, while the training loop keeps running.
@@ -107,6 +115,38 @@ def _unflatten(pairs) -> Any:
     return root
 
 
+def _npy_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _crc32(data: bytes) -> int:
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _apply_ckpt_faults(final_dir: str, epoch: int) -> None:
+    """``ckpt_truncate`` injection hook (resilience/faults.py): truncate
+    the largest leaf/piece file of a just-committed checkpoint, the
+    storage-corruption mode only CRC verification catches."""
+    from ml_trainer_tpu.resilience.faults import active_plan
+
+    plan = active_plan()
+    if plan is None or plan.fire("ckpt_truncate", epoch=epoch) is None:
+        return
+    npys = [
+        os.path.join(final_dir, n)
+        for n in os.listdir(final_dir)
+        if n.endswith(".npy")
+    ]
+    if not npys:
+        return
+    victim = max(npys, key=os.path.getsize)
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as fp:
+        fp.truncate(max(size // 2, 1))
+
+
 def _write_checkpoint_dir(
     final_dir: str, state_dict: Any, history: dict, epoch: int
 ) -> None:
@@ -124,8 +164,14 @@ def _write_checkpoint_dir(
             continue
         arr = np.asarray(jax.device_get(leaf))
         fname = f"leaf_{i:05d}.npy"
-        np.save(os.path.join(tmp_dir, fname), arr, allow_pickle=False)
-        leaves.append({"path": list(path), "file": fname})
+        # Serialize to memory first so the manifest records each file's
+        # CRC32 — restore and verify_checkpoint check it, which is what
+        # turns silent bit-rot/truncation into a quarantined checkpoint
+        # instead of a corrupted resume.
+        data = _npy_bytes(arr)
+        with open(os.path.join(tmp_dir, fname), "wb") as fp:
+            fp.write(data)
+        leaves.append({"path": list(path), "file": fname, "crc32": _crc32(data)})
     manifest = {
         "format": 2,
         "epoch": epoch,
@@ -137,6 +183,7 @@ def _write_checkpoint_dir(
     if os.path.isdir(final_dir):
         shutil.rmtree(final_dir)
     os.replace(tmp_dir, final_dir)
+    _apply_ckpt_faults(final_dir, epoch)
 
 
 def wait_for_checkpoints() -> None:
@@ -343,12 +390,13 @@ def save_checkpoint_sharded(
         for leaf_id, entries in my_pieces:
             for j, starts, stops, data in entries:
                 fname = f"leaf_{leaf_id:05d}_s{j}_p{proc:05d}.npy"
-                np.save(
-                    os.path.join(final_dir, fname), data, allow_pickle=False
-                )
+                raw = _npy_bytes(data)
+                with open(os.path.join(final_dir, fname), "wb") as fp:
+                    fp.write(raw)
                 table.append({
                     "leaf": leaf_id, "file": fname,
                     "start": starts, "stop": stops,
+                    "crc32": _crc32(raw),
                 })
         _atomic_write(
             os.path.join(final_dir, f"manifest_p{proc:05d}.json"),
@@ -373,6 +421,7 @@ def save_checkpoint_sharded(
                     "leaves": leaf_meta,
                 }).encode(),
             )
+            _apply_ckpt_faults(final_dir, epoch)
             prune_checkpoints(ckpt_dir, keep)
 
     if block:
@@ -407,7 +456,7 @@ def _read_piece_tables(path: str, nproc: Optional[int] = None) -> dict:
         with open(os.path.join(path, name)) as fp:
             for e in json.load(fp)["pieces"]:
                 tables.setdefault(e["leaf"], []).append(
-                    (e["start"], e["stop"], e["file"])
+                    (e["start"], e["stop"], e["file"], e.get("crc32"))
                 )
     return tables
 
@@ -421,7 +470,7 @@ def _stitch(path, pieces, starts, stops, shape, dtype):
         [hi - lo for lo, hi in zip(starts, stops)], dtype=dtype
     )
     filled = np.zeros(box.shape, dtype=bool)
-    for p_starts, p_stops, fname in pieces:
+    for p_starts, p_stops, fname, _crc in pieces:
         inter_lo = [max(a, b) for a, b in zip(starts, p_starts)]
         inter_hi = [min(a, b) for a, b in zip(stops, p_stops)]
         if any(lo >= hi for lo, hi in zip(inter_lo, inter_hi)):
@@ -534,6 +583,102 @@ def checkpoint_format(path: str) -> int:
         return int(json.load(fp).get("format", 2))
 
 
+def _verify_file(path: str, crc: Optional[int]) -> None:
+    """One leaf/piece file: exists, and matches its recorded CRC32 (files
+    written before CRCs existed only get the existence/parse check)."""
+    if not os.path.exists(path):
+        raise CheckpointCorrupt(f"missing checkpoint file: {path}")
+    if crc is None:
+        try:  # pre-CRC checkpoint: at least require a parseable header
+            np.load(path, allow_pickle=False, mmap_mode="r")
+        except Exception as e:
+            raise CheckpointCorrupt(f"unreadable leaf {path}: {e}") from e
+        return
+    with open(path, "rb") as fp:
+        if _crc32(fp.read()) != crc:
+            raise CheckpointCorrupt(
+                f"CRC32 mismatch for {path} (truncated or bit-rotted)"
+            )
+
+
+def verify_checkpoint(path: str) -> None:
+    """Integrity-check one checkpoint; raises ``CheckpointCorrupt`` on any
+    failure.  v2/v3 directories verify the manifest plus every referenced
+    leaf/piece file against its recorded CRC32; legacy v1 pickles only get
+    an existence/size check (their format predates integrity records)."""
+    if not os.path.isdir(path):
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            raise CheckpointCorrupt(f"missing or empty checkpoint: {path}")
+        return
+    try:
+        with open(os.path.join(path, MANIFEST)) as fp:
+            manifest = json.load(fp)
+    except (OSError, ValueError) as e:
+        raise CheckpointCorrupt(f"unreadable manifest in {path}: {e}") from e
+    if manifest.get("format") == 3:
+        try:
+            tables = _read_piece_tables(path, manifest.get("process_count"))
+        except (OSError, ValueError, KeyError) as e:
+            raise CheckpointCorrupt(
+                f"unreadable piece tables in {path}: {e}"
+            ) from e
+        for pieces in tables.values():
+            for entry in pieces:
+                _verify_file(os.path.join(path, entry[2]), entry[3])
+        return
+    for leaf in manifest.get("leaves", []):
+        if leaf.get("empty") or leaf.get("none"):
+            continue
+        _verify_file(
+            os.path.join(path, leaf["file"]), leaf.get("crc32")
+        )
+
+
+def quarantine_checkpoint(path: str) -> str:
+    """Move a corrupt checkpoint aside as ``<name>.corrupt`` (out of the
+    ``checkpoint_<n>`` namespace, so scans/prunes/restores never see it
+    again) and return the new path.  Idempotent-ish: an existing
+    quarantine of the same name is replaced."""
+    target = path + CORRUPT_SUFFIX
+    if os.path.isdir(target):
+        shutil.rmtree(target)
+    elif os.path.exists(target):
+        os.remove(target)
+    os.replace(path, target)
+    return target
+
+
+def latest_valid_checkpoint(
+    ckpt_dir: str, quarantine: bool = True
+) -> Optional[str]:
+    """Newest checkpoint that passes ``verify_checkpoint``, scanning
+    newest→oldest.  Failing checkpoints are quarantined (renamed
+    ``*.corrupt``) so the next scan skips them without re-reading; pass
+    ``quarantine=False`` to leave them in place (e.g. non-primary hosts
+    on shared storage — exactly one process should move directories)."""
+    from ml_trainer_tpu.utils.logging import get_logger
+
+    logger = get_logger("ml_trainer_tpu.checkpoint")
+    for _, name in reversed(_scan_checkpoints(ckpt_dir)):
+        full = os.path.join(ckpt_dir, name)
+        try:
+            verify_checkpoint(full)
+            return full
+        except CheckpointCorrupt as e:
+            if quarantine:
+                moved = quarantine_checkpoint(full)
+                logger.warning(
+                    f"Corrupt checkpoint quarantined: {full} -> {moved} "
+                    f"({e}); falling back to the previous checkpoint."
+                )
+            else:
+                logger.warning(
+                    f"Corrupt checkpoint skipped: {full} ({e}); falling "
+                    "back to the previous checkpoint."
+                )
+    return None
+
+
 def _reconcile_ema(state_template: Any, saved: Any) -> Any:
     """Make checkpoints portable across the ``ema_decay`` setting (and
     across its addition to TrainState).  Missing/None EMA + EMA-enabled
@@ -561,6 +706,33 @@ def _reconcile_ema(state_template: Any, saved: Any) -> Any:
     elif not want_ema:
         saved = dict(saved)
         saved["ema_params"] = None
+    return saved
+
+
+def _reconcile_guard_counters(state_template: Any, saved: Any) -> Any:
+    """Make checkpoints portable across the nonfinite-guard counters'
+    addition to TrainState (skipped_steps / bad_streak).  Pre-counter
+    checkpoints restoring into a counter-carrying template get zeros;
+    counter-carrying checkpoints restoring into a counter-less template
+    (states built outside the Trainer) drop them."""
+    if not isinstance(saved, dict):
+        return saved
+    tpl = serialization.to_state_dict(state_template)
+    if not isinstance(tpl, dict):
+        return saved
+    for key in ("skipped_steps", "bad_streak"):
+        if key not in tpl:
+            continue
+        want = tpl[key] is not None
+        if want and saved.get(key) is None:
+            saved = dict(saved)
+            saved[key] = np.zeros((), np.int32)
+        elif not want and key in saved and saved[key] is not None:
+            saved = dict(saved)
+            saved[key] = None
+        elif key not in saved:
+            saved = dict(saved)
+            saved[key] = None
     return saved
 
 
@@ -602,6 +774,7 @@ def _from_state_dict_compat(state_template: Any, saved: Any) -> Any:
     ORIGINAL mismatch is re-raised (e.g. optimizer changed between save
     and resume — the real story, not a fallback's secondary failure)."""
     saved = _reconcile_ema(state_template, saved)
+    saved = _reconcile_guard_counters(state_template, saved)
     try:
         return serialization.from_state_dict(state_template, saved)
     except (ValueError, KeyError, AttributeError) as orig:
@@ -642,6 +815,21 @@ def restore_checkpoint(
             manifest = json.load(fp)
         if manifest.get("format") == 3:
             return _restore_v3(path, manifest, state_template, shardings)
+        def load_leaf(leaf):
+            full = os.path.join(path, leaf["file"])
+            crc = leaf.get("crc32")
+            if crc is None:  # pre-CRC checkpoint
+                return np.load(full, allow_pickle=False)
+            with open(full, "rb") as fp:
+                data = fp.read()
+            if _crc32(data) != crc:
+                raise CheckpointCorrupt(
+                    f"CRC32 mismatch for {full} (truncated or bit-rotted); "
+                    "restore from an earlier checkpoint "
+                    "(latest_valid_checkpoint quarantines and falls back)"
+                )
+            return np.load(io.BytesIO(data), allow_pickle=False)
+
         pairs = [
             (
                 tuple(leaf["path"]),
@@ -649,9 +837,7 @@ def restore_checkpoint(
                 if leaf.get("empty")
                 else None
                 if leaf.get("none")
-                else np.load(
-                    os.path.join(path, leaf["file"]), allow_pickle=False
-                ),
+                else load_leaf(leaf),
             )
             for leaf in manifest["leaves"]
         ]

@@ -370,6 +370,16 @@ class NativeLoader:
                 return
             yield images, labels
         errs = self._decode_error_delta()
+        # decode_error injection hook (resilience/faults.py): exercise the
+        # corrupt-sample accounting path deterministically in tests —
+        # identical semantics to real C++-counted decode failures.
+        from ml_trainer_tpu.resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is not None and plan.fire(
+            "decode_error", epoch=self._epoch
+        ) is not None:
+            errs += 1
         if errs:
             # Corrupt streams were zero-filled to keep shapes; fail
             # the epoch loudly rather than train on silent zeros.

@@ -1,0 +1,30 @@
+"""Resilience layer: deterministic fault injection plus the hardening it
+proves out (docs/resilience.md).
+
+The injection harness lives in :mod:`ml_trainer_tpu.resilience.faults`;
+the defenses live where the failures do — the trainer's on-device
+all-finite guard, step-granular checkpoints and preemption handling
+(``trainer.py``), checkpoint CRC verification and corrupt-dir quarantine
+(``checkpoint/checkpoint.py``), and the serving watchdog/drain
+(``serving/api.py``).
+"""
+
+from ml_trainer_tpu.resilience.faults import (
+    ENV_VAR,
+    Fault,
+    FaultPlan,
+    active_plan,
+    injected,
+    install,
+    uninstall,
+)
+
+__all__ = [
+    "ENV_VAR",
+    "Fault",
+    "FaultPlan",
+    "active_plan",
+    "injected",
+    "install",
+    "uninstall",
+]

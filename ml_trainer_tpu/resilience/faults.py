@@ -1,0 +1,211 @@
+"""Deterministic fault injection — the chaos harness behind docs/resilience.md.
+
+A ``FaultPlan`` is a parsed list of faults, each a (kind, trigger,
+params) triple, injected through hooks threaded into the layers a real
+TPU job fails in:
+
+* ``nan_grad``      — trainer: the batch at the matching step is turned
+                      into NaNs so the compiled step produces non-finite
+                      loss/grads (exercises the on-device all-finite
+                      guard and rollback).
+* ``preempt``       — trainer: the matching step requests preemption —
+                      the exact path a SIGTERM takes (finish the
+                      in-flight step, emergency checkpoint, clean exit).
+* ``ckpt_truncate`` — checkpoint writer: the checkpoint of the matching
+                      epoch has one leaf file truncated AFTER the commit
+                      rename, simulating storage corruption that only
+                      CRC verification can catch.
+* ``decode_wedge``  — serving engine: the matching decode step blocks
+                      (bounded by ``secs``) as a wedged device program
+                      would; the serving watchdog must fail the clients.
+* ``decode_error``  — NativeLoader: the matching epoch reports an
+                      injected decode failure through the loader's
+                      corrupt-sample accounting path.
+
+Spec syntax (also accepted via the ``ML_TRAINER_TPU_FAULTS`` env var)::
+
+    nan_grad@step=12;ckpt_truncate@epoch=1;preempt@step=40;decode_wedge@step=5
+
+Entries are ``kind@key=value[,key=value...]`` separated by ``;``.
+Trigger keys: ``step`` (1-based train/decode step) or ``epoch``.
+Params: ``count`` (consecutive steps to fire on, default 1) and
+``secs`` (wedge hold bound, default 300).
+
+Every hook is a no-op when no plan is active, and every fault fires a
+bounded number of times — injection is reproducible, never ambient.
+Tests install plans programmatically (``install``/``injected``); the env
+var serves CLI smoke runs.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+ENV_VAR = "ML_TRAINER_TPU_FAULTS"
+
+KINDS = ("nan_grad", "preempt", "ckpt_truncate", "decode_wedge", "decode_error")
+
+
+@dataclass
+class Fault:
+    """One injectable fault: fires when its trigger matches, at most
+    ``count`` times (consecutive steps for step triggers)."""
+
+    kind: str
+    step: Optional[int] = None
+    epoch: Optional[int] = None
+    count: int = 1
+    secs: float = 300.0
+    fired: int = 0
+
+    def matches(self, step: Optional[int], epoch: Optional[int]) -> bool:
+        if self.fired >= self.count:
+            return False
+        if self.step is not None:
+            return step is not None and (
+                self.step <= step < self.step + self.count
+            )
+        if self.epoch is not None:
+            return epoch is not None and epoch == self.epoch
+        return True  # unconditional: fires `count` times, then stops
+
+    def spec(self) -> str:
+        parts = []
+        if self.step is not None:
+            parts.append(f"step={self.step}")
+        if self.epoch is not None:
+            parts.append(f"epoch={self.epoch}")
+        if self.count != 1:
+            parts.append(f"count={self.count}")
+        return self.kind + ("@" + ",".join(parts) if parts else "")
+
+
+class FaultPlan:
+    """A parsed fault list plus the wedge-release latch (thread-safe).
+
+    ``fire(kind, step=..., epoch=...)`` is the single hook entry point:
+    it returns the matching :class:`Fault` (marking one firing consumed)
+    or ``None``.  Hooks call it with whatever trigger coordinates they
+    know; a fault conditioned on a key the hook did not pass never
+    fires.
+    """
+
+    def __init__(self, faults: List[Fault]):
+        self.faults = list(faults)
+        self._lock = threading.Lock()
+        self._wedge_release = threading.Event()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults = []
+        for entry in spec.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            kind, _, args = entry.partition("@")
+            kind = kind.strip()
+            if kind not in KINDS:
+                raise ValueError(
+                    f"unknown fault kind {kind!r} in {entry!r}; "
+                    f"expected one of {sorted(KINDS)}"
+                )
+            kwargs = {}
+            for pair in filter(None, (p.strip() for p in args.split(","))):
+                key, sep, value = pair.partition("=")
+                if not sep:
+                    raise ValueError(
+                        f"malformed fault trigger {pair!r} in {entry!r} "
+                        "(expected key=value)"
+                    )
+                key = key.strip()
+                if key not in ("step", "epoch", "count", "secs"):
+                    raise ValueError(
+                        f"unknown fault key {key!r} in {entry!r}; "
+                        "expected step|epoch|count|secs"
+                    )
+                kwargs[key] = float(value) if key == "secs" else int(value)
+            faults.append(Fault(kind=kind, **kwargs))
+        return cls(faults)
+
+    def fire(self, kind: str, *, step: Optional[int] = None,
+             epoch: Optional[int] = None) -> Optional[Fault]:
+        with self._lock:
+            for fault in self.faults:
+                if fault.kind == kind and fault.matches(step, epoch):
+                    fault.fired += 1
+                    return fault
+        return None
+
+    # -- wedge latch (decode_wedge) -------------------------------------
+    def hold_wedge(self, fault: Fault) -> None:
+        """Block as a wedged decode step would, until ``release_wedge``
+        (or the fault's ``secs`` bound — injected faults must never hang
+        a process forever)."""
+        self._wedge_release.wait(timeout=fault.secs)
+
+    def release_wedge(self) -> None:
+        self._wedge_release.set()
+
+    def remaining(self) -> List[Fault]:
+        with self._lock:
+            return [f for f in self.faults if f.fired < f.count]
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({'; '.join(f.spec() for f in self.faults)})"
+
+
+# -- process-wide active plan -------------------------------------------
+# Programmatic installs win over the env var; the env spec is parsed
+# lazily and re-parsed only when its value changes (tests mutate it).
+_installed: Optional[FaultPlan] = None
+_env_cache: tuple = ("", None)
+_state_lock = threading.Lock()
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _installed
+    with _state_lock:
+        _installed = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _installed
+    with _state_lock:
+        _installed = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The installed plan, else one parsed from ``ML_TRAINER_TPU_FAULTS``
+    (cached per env value), else None.  Hook sites call this on every
+    potential injection point — it is cheap by construction."""
+    global _env_cache
+    with _state_lock:
+        if _installed is not None:
+            return _installed
+        spec = os.environ.get(ENV_VAR, "")
+        if not spec:
+            return None
+        if _env_cache[0] != spec:
+            _env_cache = (spec, FaultPlan.parse(spec))
+        return _env_cache[1]
+
+
+@contextlib.contextmanager
+def injected(spec_or_plan):
+    """Context manager: install a plan (or parse a spec string) for the
+    duration of the block."""
+    plan = (
+        spec_or_plan
+        if isinstance(spec_or_plan, FaultPlan)
+        else FaultPlan.parse(spec_or_plan)
+    )
+    install(plan)
+    try:
+        yield plan
+    finally:
+        uninstall()

@@ -17,6 +17,7 @@ from ml_trainer_tpu.serving.metrics import ServingMetrics
 from ml_trainer_tpu.serving.scheduler import (
     AdmissionError,
     DeadlineExceeded,
+    EngineUnhealthy,
     FifoScheduler,
     Request,
 )
@@ -30,4 +31,5 @@ __all__ = [
     "Request",
     "AdmissionError",
     "DeadlineExceeded",
+    "EngineUnhealthy",
 ]

@@ -13,14 +13,23 @@ driving the admit/step loop.  Callers interact through:
   ``/v1/generate`` with ``{"prompt": [ids...], "max_new_tokens": n,
   "temperature": t?, "seed": s?, "eos_token_id": e?, "deadline": d?}``
   returns ``{"tokens": [...]}``; GET ``/metrics`` returns the serving
-  metrics snapshot; GET ``/healthz`` liveness.  Backpressure maps to
-  HTTP 429, deadlines to 504.
+  metrics snapshot; GET ``/healthz`` liveness/health (503 when wedged or
+  draining).  Backpressure maps to HTTP 429, deadlines to 504.
+
+Failure contract (docs/resilience.md): clients NEVER hang on a dead
+engine.  A watchdog thread monitors the loop's heartbeat; a decode step
+that wedges past ``watchdog_timeout`` (or an engine thread that dies)
+fails every in-flight and queued request with a structured error,
+marks the server unhealthy (``/healthz`` -> 503) and refuses new
+admissions.  ``drain()`` is the graceful counterpart: stop admission,
+finish what's in flight, then ``close()``.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Optional
 
 import numpy as np
@@ -30,6 +39,7 @@ from ml_trainer_tpu.serving.metrics import ServingMetrics
 from ml_trainer_tpu.serving.scheduler import (
     AdmissionError,
     DeadlineExceeded,
+    EngineUnhealthy,
     FifoScheduler,
     Request,
     _DONE,
@@ -68,10 +78,28 @@ class TokenStream:
     def result(self, timeout: Optional[float] = None) -> np.ndarray:
         """Block until the request finishes; returns
         ``[prompt + new tokens]`` (1-D int32).  Raises
-        ``DeadlineExceeded`` / ``RuntimeError`` on failure states."""
+        ``DeadlineExceeded`` / ``RuntimeError`` on failure states, and
+        ``TimeoutError`` when ``timeout`` expires with the request still
+        unfinished — including when the engine is wedged or dead, so a
+        blocking caller always gets control back."""
+        import queue as _q
+
         if not self._drained:
+            deadline = (
+                time.monotonic() + timeout if timeout is not None else None
+            )
             while True:
-                item = self._req._stream.get(timeout=timeout)
+                left = None
+                if deadline is not None:
+                    left = max(deadline - time.monotonic(), 1e-3)
+                try:
+                    item = self._req._stream.get(timeout=left)
+                except _q.Empty:
+                    raise TimeoutError(
+                        f"request {self._req.id} not finished within "
+                        f"{timeout}s ({len(self._req.tokens)} token(s) so "
+                        "far; engine may be wedged — see Server.health())"
+                    ) from None
                 if item == _DONE:
                     self._drained = True
                     break
@@ -97,7 +125,15 @@ class Server:
                  idle_poll: float = 0.02,
                  http_port: Optional[int] = None,
                  spec_k: int = 0, drafter="ngram",
-                 draft_variables: Optional[dict] = None):
+                 draft_variables: Optional[dict] = None,
+                 watchdog_timeout: Optional[float] = 60.0):
+        """``watchdog_timeout``: seconds the engine loop may go without a
+        heartbeat WHILE work is pending before the watchdog declares it
+        wedged — fails every in-flight/queued request with a structured
+        error, marks the server unhealthy and stops admission.  Size it
+        well above the slowest single decode/prefill dispatch (first-hit
+        XLA compiles run on this thread).  ``None`` disables the
+        watchdog."""
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.engine = SlotDecodeEngine(
             model, variables, max_batch=max_batch, metrics=self.metrics,
@@ -110,12 +146,30 @@ class Server:
         self._log = get_logger("ml_trainer_tpu.serving")
         self._wake = threading.Event()
         self._stopping = False
+        self._draining = False
+        self.healthy = True
+        self._unhealthy_reason: Optional[str] = None
+        self._health_lock = threading.Lock()
+        self._last_beat = time.monotonic()
+        self._admitting_req: Optional[Request] = None
         self._httpd = None
         self._http_thread = None
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serving-engine"
         )
         self._thread.start()
+        self._watchdog_timeout = watchdog_timeout
+        self._watchdog_thread = None
+        if watchdog_timeout is not None:
+            if watchdog_timeout <= 0:
+                raise ValueError(
+                    f"watchdog_timeout must be positive or None, got "
+                    f"{watchdog_timeout}"
+                )
+            self._watchdog_thread = threading.Thread(
+                target=self._watchdog, daemon=True, name="serving-watchdog"
+            )
+            self._watchdog_thread.start()
         if http_port is not None:
             self.serve_http(port=http_port)
 
@@ -126,10 +180,20 @@ class Server:
                eos_token_id: Optional[int] = None,
                deadline: Optional[float] = None) -> TokenStream:
         """Enqueue one request (thread-safe).  Raises ``AdmissionError``
-        when the queue is at its watermark and ``ValueError`` on a
-        request the engine could never serve."""
+        when the queue is at its watermark (or the server is draining),
+        ``EngineUnhealthy`` when the engine is wedged/dead, and
+        ``ValueError`` on a request the engine could never serve."""
         if self._stopping:
             raise RuntimeError("server is closed")
+        if not self.healthy:
+            raise EngineUnhealthy(
+                self._unhealthy_reason or "serving engine unhealthy"
+            )
+        if self._draining:
+            raise AdmissionError(
+                "server is draining: admission stopped, in-flight "
+                "requests are finishing"
+            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token array")
@@ -172,6 +236,40 @@ class Server:
             timeout=timeout
         )
 
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Graceful drain: stop admission (``submit`` raises
+        ``AdmissionError``) and block until every queued + in-flight
+        request finishes, or ``timeout`` passes, or the engine goes
+        unhealthy.  Returns True when fully drained.  The usual shutdown
+        sequence is ``drain(); close()``."""
+        self._draining = True
+        deadline = time.monotonic() + timeout if timeout is not None else None
+        while self.healthy and not self._stopping:
+            if (
+                self.engine.active_count() == 0
+                and self.scheduler.queue_depth() == 0
+            ):
+                return True
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            time.sleep(min(self._idle_poll, 0.05))
+        return (
+            self.engine.active_count() == 0
+            and self.scheduler.queue_depth() == 0
+        )
+
+    def health(self) -> dict:
+        """Structured health snapshot (the ``/healthz`` payload)."""
+        return {
+            "ok": self.healthy and not self._draining and not self._stopping,
+            "healthy": self.healthy,
+            "draining": self._draining,
+            "closed": self._stopping,
+            "reason": self._unhealthy_reason,
+            "active_requests": self.engine.active_count(),
+            "queued_requests": self.scheduler.queue_depth(),
+        }
+
     def close(self) -> None:
         self._stopping = True
         self._wake.set()
@@ -188,11 +286,99 @@ class Server:
         self.close()
         return False
 
-    # -- engine loop -----------------------------------------------------
+    # -- engine loop + watchdog ------------------------------------------
+
+    def _fail_all(self, msg: str, release_slots: bool) -> None:
+        """Fail every in-flight and queued request with a structured
+        error.  ``release_slots=False`` is the watchdog path: the loop
+        thread may still be wedged inside the engine, so only the
+        request STREAMS are failed (unblocking clients) — engine/slot
+        state is cleaned up by the loop thread if it ever returns."""
+        engine, sched = self.engine, self.scheduler
+        admitting = self._admitting_req
+        if admitting is not None and admitting.state == "active":
+            admitting.finish("error", msg)
+            if release_slots:
+                self._admitting_req = None
+                if admitting.slot >= 0:
+                    try:
+                        sched.release(admitting.slot)
+                    except ValueError:
+                        pass
+        for slot, req in list(engine._active.items()):
+            if req.state == "active":
+                req.finish("error", msg)
+            if release_slots:
+                engine._active.pop(slot, None)
+                try:
+                    sched.release(slot)
+                except ValueError:
+                    pass
+        for req in sched.drain_pending():
+            req.finish("error", msg)
+
+    def _mark_unhealthy(self, reason: str) -> None:
+        """Declare the engine dead/wedged: stop admission, fail every
+        waiting client with a structured error (never hang), surface the
+        reason through ``health()``/``/healthz``.  Idempotent."""
+        with self._health_lock:
+            if not self.healthy:
+                return
+            self.healthy = False
+            self._unhealthy_reason = reason
+        self._log.error("serving_unhealthy", reason=reason)
+        self._fail_all(f"serving engine unhealthy: {reason}",
+                       release_slots=False)
+        self._wake.set()
+
+    def _watchdog(self) -> None:
+        """Detect a wedged engine: work is pending but the loop thread
+        has not heartbeaten within ``watchdog_timeout`` (it is stuck in a
+        decode/prefill dispatch).  The watchdog cannot un-wedge the
+        device program — it fails the CLIENTS fast and poisons the
+        server so callers route around it."""
+        poll = max(min(self._watchdog_timeout / 5.0, 1.0), 0.01)
+        while not self._stopping and self.healthy:
+            time.sleep(poll)
+            busy = (
+                self.engine.active_count() > 0
+                or self.scheduler.queue_depth() > 0
+                or self._admitting_req is not None
+            )
+            stale = time.monotonic() - self._last_beat
+            if busy and stale > self._watchdog_timeout:
+                self.metrics.record_watchdog_trip()
+                self._mark_unhealthy(
+                    f"decode engine wedged: no heartbeat for {stale:.1f}s "
+                    f"with {self.engine.active_count()} active and "
+                    f"{self.scheduler.queue_depth()} queued request(s)"
+                )
+                return
 
     def _loop(self) -> None:
+        try:
+            self._loop_inner()
+        except BaseException as e:  # noqa: BLE001 — thread death is the event
+            # The loop thread is dying on something even the per-iteration
+            # handler does not catch: propagate to every waiting client
+            # instead of leaving their streams blocked forever.
+            self._mark_unhealthy(
+                f"engine thread died: {type(e).__name__}: {e}"
+            )
+        finally:
+            # Shutdown (or death): fail whatever is still in flight or
+            # queued so no caller blocks forever on a stream the engine
+            # will never feed.
+            msg = (
+                "server closed" if self.healthy
+                else f"serving engine unhealthy: {self._unhealthy_reason}"
+            )
+            self._fail_all(msg, release_slots=True)
+
+    def _loop_inner(self) -> None:
         engine, sched = self.engine, self.scheduler
-        while not self._stopping:
+        while not self._stopping and self.healthy:
+            self._last_beat = time.monotonic()
             try:
                 progressed = False
                 while engine.free_capacity() > 0:
@@ -200,8 +386,14 @@ class Server:
                     if got is None:
                         break
                     req, slot = got
+                    # Tracked so a wedge or crash DURING prefill (request
+                    # popped from the queue, not yet in engine._active)
+                    # is still visible to the watchdog/error handler and
+                    # failed with the rest instead of hanging its stream.
+                    self._admitting_req = req
                     if not engine.admit(req, slot):
                         sched.release(slot)
+                    self._admitting_req = None
                     progressed = True
                 if engine.active_count():
                     for slot in engine.step():
@@ -213,26 +405,27 @@ class Server:
             except Exception as e:  # noqa: BLE001 — the loop must survive
                 # Fail every in-flight request loudly rather than hang
                 # their streams, then keep serving new ones.
-                self._log.error(
-                    "serving_engine_error", error=f"{type(e).__name__}: {e}"
-                )
+                err = f"{type(e).__name__}: {e}"
+                self._log.error("serving_engine_error", error=err)
+                self.metrics.record_engine_error()
+                admitting, self._admitting_req = self._admitting_req, None
+                if admitting is not None and admitting.state == "active":
+                    # Crashed mid-prefill: not in engine._active yet, so
+                    # the sweep below would miss it.
+                    admitting.finish("error", err)
+                    if admitting.slot >= 0:
+                        try:
+                            sched.release(admitting.slot)
+                        except ValueError:
+                            pass
                 for slot, req in list(engine._active.items()):
-                    req.finish("error", f"{type(e).__name__}: {e}")
+                    if req.state == "active":
+                        req.finish("error", err)
                     del engine._active[slot]
-                    sched.release(slot)
-        # Shutdown: fail whatever is still in flight or queued so no
-        # caller blocks forever on a stream the engine will never feed.
-        for slot, req in list(engine._active.items()):
-            req.finish("error", "server closed")
-            del engine._active[slot]
-            sched.release(slot)
-        while True:
-            got = sched.acquire()
-            if got is None:
-                break
-            req, slot = got
-            req.finish("error", "server closed")
-            sched.release(slot)
+                    try:
+                        sched.release(slot)
+                    except ValueError:
+                        pass
 
     # -- HTTP front end --------------------------------------------------
 
@@ -258,7 +451,10 @@ class Server:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    self._send(200, {"ok": True})
+                    payload = server.health()
+                    # 503 while wedged/draining so load balancers stop
+                    # routing here; the payload says why.
+                    self._send(200 if payload["ok"] else 503, payload)
                 elif self.path == "/metrics":
                     self._send(200, server.metrics.snapshot())
                 else:
@@ -282,7 +478,9 @@ class Server:
                     self._send(200, {"tokens": [int(t) for t in out]})
                 except AdmissionError as e:
                     self._send(429, {"error": str(e)})
-                except DeadlineExceeded as e:
+                except EngineUnhealthy as e:
+                    self._send(503, {"error": str(e)})
+                except (DeadlineExceeded, TimeoutError) as e:
                     self._send(504, {"error": str(e)})
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:

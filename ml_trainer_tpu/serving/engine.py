@@ -142,6 +142,7 @@ class SlotDecodeEngine:
         self._rngs = np.zeros((max_batch, 2), np.uint32)
         self._steps = np.zeros((max_batch,), np.int32)
         self._active: Dict[int, Request] = {}
+        self._step_seq = 0  # decode steps run (the decode_wedge fault clock)
 
         self._decode = self._program(
             ("serve_decode", model, max_batch), self._build_decode
@@ -369,6 +370,17 @@ class SlotDecodeEngine:
         In spec mode each slot advances 1..spec_k+1 tokens."""
         if not self._active:
             return []
+        self._step_seq += 1
+        # decode_wedge injection hook (resilience/faults.py): block like a
+        # wedged device program would — the serving watchdog's job is to
+        # fail the waiting clients while this thread is stuck here.
+        from ml_trainer_tpu.resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is not None:
+            fault = plan.fire("decode_wedge", step=self._step_seq)
+            if fault is not None:
+                plan.hold_wedge(fault)
         if self.spec_k:
             return self._step_spec()
         active_before = len(self._active)

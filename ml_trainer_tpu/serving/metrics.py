@@ -49,6 +49,11 @@ class ServingMetrics:
         self.requests_rejected = 0
         self.requests_completed = 0
         self.requests_expired = 0
+        # Resilience counters: engine-loop exceptions survived, and
+        # watchdog wedge detections (each of which failed all in-flight
+        # requests and poisoned the server).
+        self.engine_errors = 0
+        self.watchdog_trips = 0
         self.max_active_slots = 0
         self.queue_depth = 0
         # Speculative decoding (engine spec mode): acceptance accounting.
@@ -108,6 +113,14 @@ class ServingMetrics:
     def record_expiry(self) -> None:
         with self._lock:
             self.requests_expired += 1
+
+    def record_engine_error(self) -> None:
+        with self._lock:
+            self.engine_errors += 1
+
+    def record_watchdog_trip(self) -> None:
+        with self._lock:
+            self.watchdog_trips += 1
 
     def record_queue_depth(self, depth: int) -> None:
         with self._lock:
@@ -169,6 +182,8 @@ class ServingMetrics:
                 "requests_rejected": self.requests_rejected,
                 "requests_completed": self.requests_completed,
                 "requests_expired": self.requests_expired,
+                "engine_errors": self.engine_errors,
+                "watchdog_trips": self.watchdog_trips,
                 "spec_draft_k": self.spec_draft_k,
                 "spec_steps_total": self.spec_steps_total,
                 "spec_drafted_tokens": self.spec_drafted_tokens,

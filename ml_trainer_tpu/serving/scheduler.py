@@ -34,6 +34,12 @@ class DeadlineExceeded(RuntimeError):
     """The request's deadline passed before it finished."""
 
 
+class EngineUnhealthy(RuntimeError):
+    """The serving engine is wedged, dead, or draining: in-flight
+    requests were failed with a structured error and new submissions are
+    refused until the server is replaced."""
+
+
 _ids = itertools.count()
 
 # Stream sentinels (queue items are plain ints otherwise).
@@ -160,6 +166,15 @@ class FifoScheduler:
                 req.state = "active"
                 return req, req.slot
             return None
+
+    def drain_pending(self) -> list:
+        """Pop and return EVERY queued request (no slot assignment) — the
+        shutdown/watchdog path uses this to fail them loudly instead of
+        leaving their streams blocked forever."""
+        with self._lock:
+            out = list(self._pending)
+            self._pending.clear()
+            return out
 
     def release(self, slot: int) -> None:
         """Return a slot to the pool (request finished — EOS, budget,

@@ -25,3 +25,11 @@ class TrainState(struct.PyTreeNode):
     ema_params: Any = None  # EMA of params when Trainer(ema_decay=...) is
     #                         set; None (an empty pytree) otherwise, so
     #                         checkpoints without EMA keep the same leaves
+    # Nonfinite-guard counters (int32 scalars, maintained ON-DEVICE by the
+    # compiled train step so guarding adds no host sync): cumulative count
+    # of steps skipped for non-finite loss/grads, and the current streak
+    # of consecutive skipped steps (drives rollback).  None for states
+    # built outside the Trainer; checkpoints written before these fields
+    # existed restore through the compat shim (checkpoint.py).
+    skipped_steps: Any = None
+    bad_streak: Any = None

@@ -92,8 +92,22 @@ def enable_compilation_cache(path: str = "/tmp/ml_trainer_tpu_jax_cache") -> Non
     Verified to work under the remote-compile PJRT tunnel too (round-2
     probe: cached re-run of a jit cut 1.9s -> 0.3s, cache entries written,
     no client wedge), so it is no longer disabled there; set
-    ``ML_TRAINER_TPU_NO_COMPILE_CACHE=1`` to opt out."""
+    ``ML_TRAINER_TPU_NO_COMPILE_CACHE=1`` to opt out.
+
+    CPU-pinned runs (tests, the dev fallback) skip the cache entirely:
+    its whole point is amortizing minutes-long TPU compiles, CPU compiles
+    are fast — and jaxlib 0.4.36's CPU client mishandles buffer donation
+    in executables reloaded from the persistent cache (reloading a
+    donated train step intermittently corrupts the process heap; found
+    by the resilience chaos matrix, reproduced 4/5 with the cache warm
+    and 0/5 with it off)."""
     if os.environ.get("ML_TRAINER_TPU_NO_COMPILE_CACHE") == "1":
+        return
+    platforms = (
+        os.environ.get("JAX_PLATFORMS")
+        or str(getattr(jax.config, "jax_platforms", None) or "")
+    )
+    if platforms.strip().lower() == "cpu":
         return
     try:
         jax.config.update("jax_compilation_cache_dir", path)
@@ -175,6 +189,11 @@ class Trainer:
         decay_exclude_bias_norm: bool = False,
         label_smoothing: float = 0.0,
         sharded_checkpoint: Optional[bool] = None,
+        nonfinite_guard: bool = True,
+        rollback_bad_steps: Optional[int] = None,
+        rollback_lr_backoff: float = 0.5,
+        save_every_steps: Optional[int] = None,
+        handle_preemption: bool = True,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -262,9 +281,40 @@ class Trainer:
         full-tree gather is not just a RAM spike but a deadlock (one
         process launching a global allgather the others never join).
         The reference's rank-0 save (ref: src/trainer.py:252-254)
-        generalized to sharded state."""
+        generalized to sharded state.
+
+        Resilience knobs (docs/resilience.md):
+
+        ``nonfinite_guard`` (default True): the compiled train step
+        checks loss and every gradient leaf for finiteness ON-DEVICE and
+        ``where``-selects the previous state when the check fails — the
+        bad step is skipped with no recompilation and no host sync, the
+        skipped/streak counters live in ``TrainState`` (fetched once per
+        epoch into ``history['skipped_steps']``).  With all-finite math
+        the trajectory is bit-identical to the unguarded step.
+
+        ``rollback_bad_steps``: after this many CONSECUTIVE skipped
+        steps, restore the newest checkpoint that verifies (corrupt ones
+        are quarantined) and scale the LR by ``rollback_lr_backoff``
+        (compounding per rollback) — the escape hatch for a diverged
+        run that keeps producing NaNs from poisoned state.  Checked at
+        the existing ``log_every`` sync points, so it adds no extra
+        per-step host sync.  ``None`` (default) disables rollback.
+
+        ``save_every_steps``: additionally checkpoint every N optimizer
+        steps WITHIN an epoch, with the batch cursor and epoch
+        accumulators in the manifest, so ``fit(resume=True)`` restarts
+        mid-epoch bit-exactly (the resumed trajectory equals the
+        uninterrupted one).  Requires ``steps_per_execution=1`` (the
+        per-batch dispatch path owns the step cursor).
+
+        ``handle_preemption`` (default True): ``fit()`` installs
+        SIGTERM/SIGINT handlers (restored on exit) that finish the
+        in-flight step, write an emergency mid-epoch checkpoint plus a
+        clean-exit marker, and return with ``self.preempted = True`` —
+        the preemptible-TPU contract.  ``fit(resume=True)`` picks the
+        marker up and continues where the signal landed."""
         logger.info("Config inputs.", config=config)
-        enable_compilation_cache()
         cfg = TrainerConfig.from_kwargs(**config)
         self.config = cfg
         if cfg.backend == "cpu":
@@ -297,6 +347,10 @@ class Trainer:
                 "Trainer(backend='cpu') pinned the host platform for this "
                 "process; this run will execute on CPU."
             )
+        # After the backend pin, so a backend='cpu' run is seen as CPU by
+        # the cache gate (CPU runs skip the persistent cache — see
+        # enable_compilation_cache).
+        enable_compilation_cache()
         # Parity attribute names (ref: src/trainer.py:30-41).
         self.epochs = epochs
         self.scheduler_type = cfg.scheduler
@@ -371,6 +425,36 @@ class Trainer:
         # shared storage across hosts (GCS/NFS, the normal pod setup).
         # None = resolve from the state's shardings once they exist.
         self._sharded_ckpt = sharded_checkpoint
+        self.nonfinite_guard = bool(nonfinite_guard)
+        if rollback_bad_steps is not None and rollback_bad_steps < 1:
+            raise ValueError(
+                f"rollback_bad_steps must be >= 1, got {rollback_bad_steps}"
+            )
+        self.rollback_bad_steps = rollback_bad_steps
+        if not (0.0 < rollback_lr_backoff <= 1.0):
+            raise ValueError(
+                f"rollback_lr_backoff must be in (0, 1], got "
+                f"{rollback_lr_backoff}"
+            )
+        self.rollback_lr_backoff = float(rollback_lr_backoff)
+        if save_every_steps is not None:
+            if save_every_steps < 1:
+                raise ValueError(
+                    f"save_every_steps must be >= 1, got {save_every_steps}"
+                )
+            if self.steps_per_execution > 1:
+                raise ValueError(
+                    "save_every_steps (step-granular mid-epoch checkpoints) "
+                    "requires steps_per_execution=1: the multi-step scan "
+                    "dispatch has no per-batch cursor to checkpoint"
+                )
+        self.save_every_steps = save_every_steps
+        self.handle_preemption = bool(handle_preemption)
+        self.preempted = False
+        self._preempt_requested = False
+        self.skipped_steps: list = []  # per-epoch skipped-step counts
+        self._skipped_base = 0  # cumulative counter at current epoch start
+        self._resume_mid: Optional[dict] = None  # mid-epoch resume cursor
         self._best_val = math.inf
         self._bad_epochs = 0
         if self.is_parallel:
@@ -707,6 +791,14 @@ class Trainer:
             batch_stats=batch_stats,
             rng=jax.device_put(state_rng, self._replicated),
             ema_params=ema_params,
+            # Guard counters ride in the state so the compiled step can
+            # maintain them without a host sync (fetched once per epoch).
+            skipped_steps=jax.device_put(
+                jnp.zeros((), jnp.int32), self._replicated
+            ),
+            bad_streak=jax.device_put(
+                jnp.zeros((), jnp.int32), self._replicated
+            ),
         )
         self._state_shardings = jax.tree.map(lambda x: x.sharding, self.state)
         if self._sharded_ckpt is None:
@@ -775,6 +867,7 @@ class Trainer:
         aux_weight = self.moe_aux_weight
         accum = self.grad_accum_steps
         ema_decay = self.ema_decay
+        guard = self.nonfinite_guard
 
         def grads_for(params, batch_stats, x, y, dropout_rng):
             def loss_fn(params):
@@ -864,6 +957,44 @@ class Trainer:
                 )
                 if ema_decay is not None else state.ema_params
             )
+            new_skipped, new_streak = state.skipped_steps, state.bad_streak
+            if guard:
+                # On-device all-finite guard: a non-finite loss or any
+                # non-finite gradient leaf reverts every learned quantity
+                # to the pre-step value via `where` selects — same
+                # compiled program either way (no lax.cond branch, no
+                # recompile, no host sync).  step/rng still advance (the
+                # batch was consumed; the LR schedule and dropout stream
+                # stay aligned with the data), while the optimizer's
+                # inner counters revert with the moments — the skipped
+                # step never happened as far as Adam bias correction is
+                # concerned.  When everything is finite, `where(ok, n, o)
+                # == n` exactly, so guarded and unguarded trajectories
+                # are bit-identical.
+                ok = jnp.isfinite(loss)
+                for g in jax.tree.leaves(grads):
+                    ok = ok & jnp.all(jnp.isfinite(g))
+
+                def sel(n, o):
+                    return jax.tree.map(
+                        lambda a, b: jnp.where(ok, a, b), n, o
+                    )
+
+                new_params = sel(new_params, state.params)
+                new_opt = sel(new_opt, state.opt_state)
+                new_bs = sel(new_bs, state.batch_stats)
+                if ema_decay is not None:
+                    new_ema = sel(new_ema, state.ema_params)
+                one = jnp.ones((), jnp.int32)
+                zero = jnp.zeros((), jnp.int32)
+                new_skipped = state.skipped_steps + jnp.where(ok, zero, one)
+                new_streak = jnp.where(ok, zero, state.bad_streak + one)
+                # A skipped step contributes zero to the epoch sums so
+                # one NaN cannot poison the whole epoch's history.
+                loss = jnp.where(ok, loss, jnp.zeros_like(loss))
+                metric_val = jnp.where(
+                    ok, metric_val, jnp.zeros_like(metric_val)
+                )
             new_state = state.replace(
                 step=state.step + 1,
                 params=new_params,
@@ -871,6 +1002,8 @@ class Trainer:
                 batch_stats=new_bs,
                 rng=rng,
                 ema_params=new_ema,
+                skipped_steps=new_skipped,
+                bad_streak=new_streak,
             )
             return new_state, loss, metric_val
 
@@ -956,18 +1089,60 @@ class Trainer:
         lr_scale = jnp.asarray(self._lr_scale, jnp.float32)
         if self.steps_per_execution > 1:
             loss_sum, metric_sum = self._train_one_epoch_multi(n, lr_scale)
+            if self._preempt_requested:
+                # Multi-step dispatch has no per-batch cursor: no
+                # emergency mid-epoch save — resume restarts from the
+                # last epoch-boundary checkpoint (documented trade).
+                self.preempted = True
+                return
         else:
+            start_b = 0
+            mid, self._resume_mid = self._resume_mid, None
+            if mid is not None and int(mid["epoch"]) == epoch:
+                # Mid-epoch resume: SKIP the batches the interrupted run
+                # already trained on.  Skipping still consumes them from
+                # the loader (the augmentation rng advances identically),
+                # so the remaining steps see exactly the batches the
+                # uninterrupted run would — bit-exact continuation.
+                start_b = int(mid["batches_done"])
+                loss_sum = jnp.asarray(float(mid["loss_sum"]), jnp.float32)
+                metric_sum = jnp.asarray(
+                    float(mid["metric_sum"]), jnp.float32
+                )
+                self._skipped_base = int(mid.get("skipped_base", 0))
+                logger.info(
+                    f"Mid-epoch resume: epoch {epoch} continues at batch "
+                    f"{start_b + 1}/{n}."
+                )
+            it = iter(self.train_loader)
+            for _ in range(start_b):
+                next(it)
+            from ml_trainer_tpu.resilience import faults
+
+            plan = faults.active_plan()
             batches = prefetch_to_device(
-                self.train_loader, size=2, sharding=self._batch_sharding
+                it, size=2, sharding=self._batch_sharding
             )
-            with tqdm(batches, total=n, unit="batch") as tepoch:
+            with tqdm(
+                batches, total=n, initial=start_b, unit="batch"
+            ) as tepoch:
                 for i, (x, y) in enumerate(tepoch):
+                    done = start_b + i + 1  # 1-based batch cursor
+                    if plan is not None:
+                        # Fault step coordinates are 1-based global train
+                        # steps ((epoch-1)*steps_per_epoch + batch) —
+                        # pure host arithmetic, no device sync.
+                        gstep = (epoch - 1) * n + done
+                        if plan.fire("preempt", step=gstep) is not None:
+                            self._request_preemption("injected preempt")
+                        if plan.fire("nan_grad", step=gstep) is not None:
+                            x = self._poison_batch(x)
                     self.state, loss, metric_val = self._train_step(
                         self.state, x, y, lr_scale
                     )
                     loss_sum = loss_sum + loss
                     metric_sum = metric_sum + metric_val
-                    if (i + 1) % self.log_every == 0 or (i + 1) == n:
+                    if done % self.log_every == 0 or done == n:
                         # The only host syncs in the epoch (the reference
                         # pays one per batch, ref: src/trainer.py:186).
                         # Display matches the reference's running-average-
@@ -975,13 +1150,45 @@ class Trainer:
                         if self.metric:
                             tepoch.set_postfix(
                                 loss=float(loss_sum) / n,
-                                metric=self._postfix_metric(metric_sum, i + 1, n),
+                                metric=self._postfix_metric(
+                                    metric_sum, done, n
+                                ),
                             )
                         else:
                             tepoch.set_postfix(loss=float(loss))
+                        if self._maybe_rollback():
+                            lr_scale = jnp.asarray(
+                                self._lr_scale, jnp.float32
+                            )
+                    if (
+                        self.save_every_steps
+                        and done % self.save_every_steps == 0
+                        and done < n
+                    ):
+                        self._save_mid_epoch(
+                            epoch, done, loss_sum, metric_sum
+                        )
+                    if self._preempt_requested:
+                        # The in-flight step finished above; emergency
+                        # checkpoint with the batch cursor, then exit.
+                        self._save_mid_epoch(
+                            epoch, done, loss_sum, metric_sum
+                        )
+                        ckpt.wait_for_checkpoints()
+                        self._preempt_info = {
+                            "epoch": epoch, "batches_done": done,
+                        }
+                        self.preempted = True
+                        break
+            if self.preempted:
+                return  # partial epoch: no history entry, fit() stops
         # float(loss_sum) above fenced the device work, so this timestamp
         # covers actual execution, not async dispatch.
         self.train_losses.append(float(loss_sum) / n)
+        if self.state.skipped_steps is not None:
+            cum = int(jax.device_get(self.state.skipped_steps))
+            self.skipped_steps.append(cum - self._skipped_base)
+            self._skipped_base = cum
         dt = time.time() - epoch_t0
         logger.info(
             f"Epoch {epoch}: {n * self.global_batch / max(dt, 1e-9):,.0f} "
@@ -1028,6 +1235,8 @@ class Trainer:
                 done += k
                 tepoch.update(k)
                 log(k, loss)
+                if self._preempt_requested:
+                    return loss_sum, metric_sum
             for x, y in prefetch_to_device(
                 iter(tail), size=2, sharding=self._batch_sharding
             ):
@@ -1039,6 +1248,8 @@ class Trainer:
                 done += 1
                 tepoch.update(1)
                 log(1, loss)
+                if self._preempt_requested:
+                    return loss_sum, metric_sum
         return loss_sum, metric_sum
 
     def _validate_one_epoch(self) -> None:
@@ -1108,7 +1319,57 @@ class Trainer:
     def fit(self, resume: bool = False) -> None:
         """Full training run (ref: src/trainer.py:243-275).  ``resume=True``
         restarts from the latest full checkpoint — a capability the
-        reference lacks (SURVEY.md §5)."""
+        reference lacks (SURVEY.md §5).  With ``handle_preemption`` (the
+        default) SIGTERM/SIGINT trigger a clean preemption exit: finish
+        the in-flight step, write an emergency checkpoint + exit marker,
+        return with ``self.preempted = True``; ``fit(resume=True)`` then
+        continues where the signal landed (bit-exactly mid-epoch when
+        ``save_every_steps`` semantics apply)."""
+        self.preempted = False
+        self._preempt_requested = False
+        self._preempt_info: Optional[dict] = None
+        prev_handlers = self._install_preempt_handlers()
+        try:
+            self._fit(resume)
+        finally:
+            self._restore_preempt_handlers(prev_handlers)
+
+    def _install_preempt_handlers(self):
+        if not self.handle_preemption:
+            return {}
+        import signal
+
+        prev = {}
+        try:
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                prev[sig] = signal.signal(sig, self._on_preempt_signal)
+        except ValueError:
+            # Not the main thread: signals cannot be installed here; the
+            # injected `preempt` fault path still works.
+            return prev
+        return prev
+
+    def _restore_preempt_handlers(self, prev) -> None:
+        import signal
+
+        for sig, handler in prev.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, TypeError):
+                pass
+
+    def _on_preempt_signal(self, signum, frame) -> None:
+        self._request_preemption(f"signal {signum}")
+
+    def _request_preemption(self, reason: str) -> None:
+        if not self._preempt_requested:
+            logger.warning(
+                f"Preemption requested ({reason}): finishing the in-flight "
+                "step, then writing an emergency checkpoint."
+            )
+        self._preempt_requested = True
+
+    def _fit(self, resume: bool) -> None:
         logger.info("Start training..")
         start_epoch = 1
         ckpt_dir = os.path.join(self.model_dir, "checkpoints")
@@ -1122,6 +1383,13 @@ class Trainer:
                 break
             logger.info(f"{'-' * 30} EPOCH {epoch} / {self.epochs} {'-' * 30}")
             self._train_one_epoch(epoch)
+            if self.preempted:
+                self._write_preempt_marker(ckpt_dir)
+                logger.warning(
+                    "Preempted: emergency checkpoint committed; exiting "
+                    "fit() cleanly (resume with fit(resume=True))."
+                )
+                break
             self.clear()
             self._validate_one_epoch()
             self.clear()
@@ -1206,6 +1474,9 @@ class Trainer:
             "train_metric": self.train_metrics,
             "val_metric": self.val_metrics,
             "metric_type": self.metric,
+            # Per-epoch count of steps the on-device all-finite guard
+            # skipped (all zeros on a healthy run).
+            "skipped_steps": self.skipped_steps,
         }
         if self.save_history and is_primary():
             self.save_history_(self.model_dir)
@@ -1232,6 +1503,7 @@ class Trainer:
             "val_metric": self.val_metrics,
             "metric_type": self.metric,
             "lr_scale": self._lr_scale,
+            "skipped_steps": self.skipped_steps,
         }
         if self._plateau is not None:
             h["plateau"] = {
@@ -1255,6 +1527,7 @@ class Trainer:
         self.val_losses = list(saved.get("val_loss", []))
         self.train_metrics = list(saved.get("train_metric", []))
         self.val_metrics = list(saved.get("val_metric", []))
+        self.skipped_steps = list(saved.get("skipped_steps", []))
         self._lr_scale = float(saved.get("lr_scale", 1.0))
         plateau = saved.get("plateau", {})
         if self._plateau is not None:
@@ -1265,6 +1538,153 @@ class Trainer:
         self._best_val = float(early.get("best_val", np.inf))
         self._bad_epochs = int(early.get("bad_epochs", 0))
 
+    # ------------------------------------------------------------ resilience
+    @staticmethod
+    def _poison_batch(x):
+        """``nan_grad`` fault: NaN-fill a float batch so the compiled step
+        produces non-finite loss/grads (the guard's job to absorb)."""
+        if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+            return x * jnp.nan
+        logger.warning(
+            "nan_grad fault ignored: integer input batch cannot carry NaN"
+        )
+        return x
+
+    def _save_mid_epoch(
+        self, epoch: int, batches_done: int, loss_sum, metric_sum
+    ) -> None:
+        """Step-granular checkpoint: epoch ``epoch`` is IN PROGRESS with
+        ``batches_done`` batches trained.  The manifest's ``mid_epoch``
+        record carries the batch cursor plus the epoch accumulators so
+        ``fit(resume=True)`` continues bit-exactly; the end-of-epoch save
+        overwrites the same ``checkpoint_<epoch>`` directory.  Costs one
+        scalar device sync per save (the accumulator fetch)."""
+        hist = self._partial_history()
+        hist["mid_epoch"] = {
+            "epoch": int(epoch),
+            "batches_done": int(batches_done),
+            "loss_sum": float(loss_sum),
+            "metric_sum": float(metric_sum),
+            "skipped_base": int(self._skipped_base),
+        }
+        ckpt_dir = os.path.join(self.model_dir, "checkpoints")
+        if self._sharded_ckpt:
+            ckpt.save_checkpoint_sharded(
+                ckpt_dir, self.state, hist, epoch, block=False
+            )
+        elif is_primary():
+            # Async: the writer thread serializes this with epoch-end
+            # saves (single-queue FIFO), so same-epoch writes never race.
+            ckpt.save_checkpoint(
+                ckpt_dir, self.state, hist, epoch, block=False
+            )
+
+    def _maybe_rollback(self) -> bool:
+        """Rollback-to-last-good: when ``rollback_bad_steps`` CONSECUTIVE
+        steps were skipped as non-finite, restore the newest checkpoint
+        that verifies (corrupt ones quarantined) and back the LR off by
+        ``rollback_lr_backoff``.  Called at the ``log_every`` sync
+        cadence; the check costs one scalar fetch and only runs when
+        rollback is enabled."""
+        if self.rollback_bad_steps is None or self.state.bad_streak is None:
+            return False
+        streak = int(jax.device_get(self.state.bad_streak))
+        if streak < self.rollback_bad_steps:
+            return False
+        self._lr_scale *= self.rollback_lr_backoff
+        zero = jax.device_put(jnp.zeros((), jnp.int32), self._replicated)
+        ckpt_dir = os.path.join(self.model_dir, "checkpoints")
+        ckpt.wait_for_checkpoints()  # in-flight async writes must land
+        latest = ckpt.latest_valid_checkpoint(
+            ckpt_dir, quarantine=is_primary()
+        )
+        if latest is None:
+            # The guard already reverted every bad update, so the live
+            # params ARE the last good ones; just clear the streak.
+            logger.warning(
+                f"Rollback: {streak} consecutive non-finite steps and no "
+                f"valid checkpoint; LR scale backed off to "
+                f"{self._lr_scale:.4g}, continuing from current params."
+            )
+            self.state = self.state.replace(bad_streak=zero)
+            return True
+        skipped_now = self.state.skipped_steps
+        if ckpt.checkpoint_format(latest) == 3:
+            state, _, _ = ckpt.restore_checkpoint(
+                latest, self.state, self._state_shardings
+            )
+            self.state = state
+        else:
+            state, _, _ = ckpt.restore_checkpoint(
+                latest, ckpt.fetch_to_host(self.state)
+            )
+            self.state = jax.device_put(state, self._state_shardings)
+        # Keep the cumulative skipped count (diagnostics) but clear the
+        # streak — the restored counters predate the event.
+        self.state = self.state.replace(
+            bad_streak=zero, skipped_steps=skipped_now
+        )
+        logger.warning(
+            f"Rollback: {streak} consecutive non-finite steps; restored "
+            f"{latest} and backed LR off to scale {self._lr_scale:.4g}."
+        )
+        return True
+
+    def _write_preempt_marker(self, ckpt_dir: str) -> None:
+        """Clean-exit marker: proves the process exited through the
+        preemption path (emergency checkpoint committed) rather than
+        crashing; ``fit(resume=True)`` logs and consumes it."""
+        if not is_primary():
+            return
+        import json
+
+        os.makedirs(ckpt_dir, exist_ok=True)
+        info = dict(self._preempt_info or {})
+        info["time"] = time.time()
+        tmp = os.path.join(ckpt_dir, "PREEMPTED.json.tmp")
+        with open(tmp, "w") as fp:
+            json.dump(info, fp)
+        os.replace(tmp, os.path.join(ckpt_dir, "PREEMPTED.json"))
+
+    def _consume_preempt_marker(self, ckpt_dir: str) -> None:
+        marker = os.path.join(ckpt_dir, "PREEMPTED.json")
+        if not os.path.exists(marker):
+            return
+        import json
+
+        try:
+            with open(marker) as fp:
+                info = json.load(fp)
+        except (OSError, ValueError):
+            info = {}
+        logger.info(
+            f"Clean preemption exit detected ({info}); resuming from the "
+            "emergency checkpoint."
+        )
+        if is_primary():
+            try:
+                os.remove(marker)
+            except OSError:
+                pass
+
+    def _sync_skipped_base(self) -> None:
+        """Re-anchor the per-epoch skipped-step delta after a restore (one
+        scalar fetch; the mid-epoch marker overrides this with the value
+        at the interrupted epoch's start)."""
+        self._skipped_base = (
+            int(jax.device_get(self.state.skipped_steps))
+            if self.state.skipped_steps is not None else 0
+        )
+
+    def _require_mid_resume_support(self) -> None:
+        if self.steps_per_execution > 1:
+            raise ValueError(
+                "the latest checkpoint is mid-epoch (written by "
+                "save_every_steps or a preemption exit), which resumes "
+                "through the per-batch dispatch path; restart with "
+                "steps_per_execution=1 to continue it"
+            )
+
     def _resume_from_latest(self, ckpt_dir: str) -> int:
         """Restore the latest full checkpoint, multi-host-safely.
 
@@ -1274,7 +1694,13 @@ class Trainer:
         restored state are broadcast to every host so all processes start
         the same epoch with identical replicated state.
         """
-        latest = ckpt.latest_checkpoint(ckpt_dir)
+        self._consume_preempt_marker(ckpt_dir)
+        # Valid-only: corrupt checkpoints (CRC mismatch, missing leaves)
+        # are quarantined (*.corrupt) by the primary and the scan falls
+        # back to the newest one that verifies.
+        latest = ckpt.latest_valid_checkpoint(
+            ckpt_dir, quarantine=is_primary()
+        )
         multi_host = process_count() > 1
         fmt = ckpt.checkpoint_format(latest) if latest is not None else 0
         epoch_in_name = (
@@ -1317,6 +1743,16 @@ class Trainer:
             )
             self.state = state
             self._apply_resume_scalars(saved)
+            self._sync_skipped_base()
+            mid = saved.get("mid_epoch")
+            if mid is not None:
+                self._require_mid_resume_support()
+                self._resume_mid = dict(mid)
+                logger.info(
+                    f"Resuming mid-epoch {mid['epoch']} at batch "
+                    f"{mid['batches_done']} ({latest}, sharded)."
+                )
+                return int(mid["epoch"])
             logger.info(
                 f"Resuming from epoch {done_epoch + 1} ({latest}, sharded)."
             )
@@ -1329,6 +1765,7 @@ class Trainer:
             state, saved, done_epoch = ckpt.fetch_to_host(self.state), {}, 0
         plateau = saved.get("plateau", {})
         early = saved.get("early_stop", {})
+        mid = saved.get("mid_epoch") or {}
         scalars = np.asarray(
             [
                 done_epoch,
@@ -1338,6 +1775,15 @@ class Trainer:
                 plateau.get("scale", 1.0),
                 early.get("best_val", np.inf),
                 early.get("bad_epochs", 0),
+                # Mid-epoch resume cursor (zeros when resuming from an
+                # epoch boundary); float32 sums round-trip exactly
+                # through float64, so bit-exact resume survives the
+                # broadcast.
+                1.0 if mid else 0.0,
+                mid.get("batches_done", 0),
+                mid.get("loss_sum", 0.0),
+                mid.get("metric_sum", 0.0),
+                mid.get("skipped_base", 0),
             ],
             dtype=np.float64,
         )
@@ -1353,6 +1799,7 @@ class Trainer:
         self.val_losses = list(saved.get("val_loss", []))
         self.train_metrics = list(saved.get("train_metric", []))
         self.val_metrics = list(saved.get("val_metric", []))
+        self.skipped_steps = list(saved.get("skipped_steps", []))
         done_epoch = int(scalars[0])
         self._lr_scale = float(scalars[1])
         if self._plateau is not None:
@@ -1361,6 +1808,23 @@ class Trainer:
             self._plateau.scale = float(scalars[4])
         self._best_val = float(scalars[5])
         self._bad_epochs = int(scalars[6])
+        self._sync_skipped_base()
+        if scalars[7]:
+            # Mid-epoch checkpoint: re-enter the manifest's epoch at the
+            # saved batch cursor instead of starting the next epoch.
+            self._require_mid_resume_support()
+            self._resume_mid = {
+                "epoch": done_epoch,
+                "batches_done": int(scalars[8]),
+                "loss_sum": float(scalars[9]),
+                "metric_sum": float(scalars[10]),
+                "skipped_base": int(scalars[11]),
+            }
+            logger.info(
+                f"Resuming mid-epoch {done_epoch} at batch "
+                f"{int(scalars[8])} ({latest})."
+            )
+            return done_epoch
         start_epoch = done_epoch + 1
         logger.info(f"Resuming from epoch {start_epoch} ({latest}).")
         return start_epoch

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Fault-injection smoke leg (scripts/fastlane.sh) — ~30s on CPU.
+
+One tiny end-to-end pass over the resilience layer's two cheapest
+guarantees, as a standalone script so the fast lane exercises the REAL
+env-var plumbing (``ML_TRAINER_TPU_FAULTS``), not just the programmatic
+test hooks:
+
+1. ``nan_grad`` — the injected NaN step is skipped on-device, counted in
+   ``history['skipped_steps']``, and the run finishes finite.
+2. ``preempt`` — the injected preemption exits ``fit()`` cleanly with an
+   emergency checkpoint + marker, and ``fit(resume=True)`` reproduces the
+   uninterrupted run's final params bit-for-bit.
+
+Exits non-zero (with a reason) on any violation.
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax
+
+    from ml_trainer_tpu import Trainer, MLModel
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+    from ml_trainer_tpu.resilience import faults
+    from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+    def mk(model_dir, **kw):
+        t = custom_pre_process_function()
+        return Trainer(
+            MLModel(),
+            datasets=(SyntheticCIFAR10(size=64, seed=0, transform=t),
+                      SyntheticCIFAR10(size=32, seed=1, transform=t)),
+            epochs=2, batch_size=16, model_dir=model_dir, metric=None,
+            lr=0.01, **kw,
+        )
+
+    def fail(msg):
+        print(f"CHAOS_SMOKE FAIL: {msg}")
+        return 1
+
+    # Reference: uninterrupted run.
+    ref = mk(tempfile.mkdtemp())
+    ref.fit()
+
+    # 1. nan_grad via the env var (the CLI-facing injection path).
+    os.environ[faults.ENV_VAR] = "nan_grad@step=3"
+    try:
+        t = mk(tempfile.mkdtemp())
+        t.fit()
+    finally:
+        del os.environ[faults.ENV_VAR]
+    if t.history["skipped_steps"] != [1, 0]:
+        return fail(f"nan_grad skip counts {t.history['skipped_steps']}")
+    if not all(np.isfinite(v) for v in t.train_losses):
+        return fail(f"non-finite history {t.train_losses}")
+    if not all(
+        np.all(np.isfinite(leaf)) for leaf in jax.tree.leaves(t.state.params)
+    ):
+        return fail("non-finite params after guarded NaN step")
+    print("CHAOS_SMOKE nan_grad: skipped step counted, run finite")
+
+    # 2. preempt mid-epoch-2 + bit-exact resume.
+    d = tempfile.mkdtemp()
+    with faults.injected("preempt@step=6"):
+        t1 = mk(d, save_every_steps=2)
+        t1.fit()
+    if not t1.preempted:
+        return fail("preempt fault did not trip fit()")
+    marker = os.path.join(d, "checkpoints", "PREEMPTED.json")
+    if not os.path.exists(marker):
+        return fail("no clean-exit marker after preemption")
+    t2 = mk(d, save_every_steps=2)
+    t2.fit(resume=True)
+    if t2.history["epochs"] != ref.history["epochs"]:
+        return fail(f"resumed epochs {t2.history['epochs']}")
+    for a, b in zip(
+        jax.tree.leaves(ref.state.params), jax.tree.leaves(t2.state.params)
+    ):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            return fail("resumed params differ from uninterrupted run")
+    print("CHAOS_SMOKE preempt: clean exit, bit-exact mid-epoch resume")
+    print("CHAOS_SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,14 @@
 #!/usr/bin/env bash
 # The tier-1 verify gate, EXACTLY as ROADMAP.md specifies it — one
 # committed wrapper so the builder and the reviewer run the identical
-# command (pipefail, CPU pinned, fast lane only, DOTS_PASSED count).
+# command (pipefail, CPU pinned, fast lane only, DOTS_PASSED count) —
+# plus a fault-injection smoke leg (scripts/chaos_smoke.py) covering the
+# resilience layer's env-var plumbing end to end.
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
-# Exits with pytest's status; prints DOTS_PASSED=<n> as the last line.
+# Exits non-zero if either leg fails; prints DOTS_PASSED=<n> as the
+# last line (the tier-1 count, unchanged by the smoke leg).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
 rm -f /tmp/_t1.log
@@ -13,5 +16,10 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
+echo "# fault-injection smoke leg"
+timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+smoke_rc=$?
+[ $smoke_rc -ne 0 ] && echo "# chaos smoke FAILED (rc=$smoke_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+[ $rc -eq 0 ] && rc=$smoke_rc
 exit $rc

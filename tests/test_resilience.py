@@ -1,0 +1,518 @@
+"""Resilience layer chaos matrix (ml_trainer_tpu/resilience/).
+
+Every fault class in ``FaultPlan`` is injected deterministically and the
+corresponding defense verified end to end on CPU:
+
+* ``nan_grad``      -> on-device guard skips the step (no recompile),
+                       counters land in history, run stays finite;
+* ``preempt``       -> clean exit + emergency checkpoint, and the
+                       resumed trajectory is BIT-IDENTICAL to an
+                       uninterrupted run (mid-epoch, not just per-epoch);
+* ``ckpt_truncate`` -> CRC catches it, the corrupt dir is quarantined,
+                       restore falls back to the newest valid checkpoint;
+* ``decode_wedge``  -> the serving watchdog fails all in-flight clients
+                       with a structured error and reports unhealthy —
+                       nobody hangs;
+* ``decode_error``  -> the NativeLoader surfaces injected corrupt-sample
+                       accounting loudly.
+
+The fast subset runs in tier-1; the heavier combined scenarios carry
+``@pytest.mark.slow``.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from ml_trainer_tpu import Trainer, MLModel
+from ml_trainer_tpu import checkpoint as ckpt
+from ml_trainer_tpu.checkpoint.checkpoint import CheckpointCorrupt
+from ml_trainer_tpu.data import SyntheticCIFAR10
+from ml_trainer_tpu.resilience import FaultPlan, faults
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+
+def make_trainer(model_dir, epochs=2, size=64, **kw):
+    t = custom_pre_process_function()  # float batches: NaN-poisonable
+    return Trainer(
+        MLModel(),
+        datasets=(SyntheticCIFAR10(size=size, seed=0, transform=t),
+                  SyntheticCIFAR10(size=32, seed=1, transform=t)),
+        epochs=epochs, batch_size=16, model_dir=str(model_dir),
+        metric=None, lr=0.01, **kw,
+    )
+
+
+def params_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+# --------------------------------------------------------------- fault plans
+def test_fault_plan_parse_roundtrip():
+    plan = FaultPlan.parse(
+        "nan_grad@step=12;ckpt_truncate@epoch=1;preempt@step=40;"
+        "decode_wedge@step=5,secs=2"
+    )
+    kinds = [f.kind for f in plan.faults]
+    assert kinds == ["nan_grad", "ckpt_truncate", "preempt", "decode_wedge"]
+    assert plan.faults[0].step == 12
+    assert plan.faults[1].epoch == 1
+    assert plan.faults[3].secs == 2.0
+    # fire() consumes exactly one firing, only on a matching trigger.
+    assert plan.fire("nan_grad", step=11) is None
+    assert plan.fire("nan_grad", step=12) is not None
+    assert plan.fire("nan_grad", step=12) is None
+    assert plan.fire("ckpt_truncate", epoch=2) is None
+    assert plan.fire("ckpt_truncate", epoch=1) is not None
+    assert len(plan.remaining()) == 2
+
+
+def test_fault_plan_count_window_and_env(monkeypatch):
+    plan = FaultPlan.parse("nan_grad@step=5,count=3")
+    assert plan.fire("nan_grad", step=4) is None
+    for s in (5, 6, 7):
+        assert plan.fire("nan_grad", step=s) is not None
+    assert plan.fire("nan_grad", step=8) is None
+    # Env-var plumbing: active_plan() parses and caches per value.
+    monkeypatch.setenv(faults.ENV_VAR, "preempt@step=2")
+    p = faults.active_plan()
+    assert p is not None and p.faults[0].kind == "preempt"
+    assert faults.active_plan() is p  # cached
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert faults.active_plan() is None
+
+
+def test_fault_plan_rejects_garbage():
+    with pytest.raises(ValueError, match="unknown fault kind"):
+        FaultPlan.parse("meteor_strike@step=1")
+    with pytest.raises(ValueError, match="unknown fault key"):
+        FaultPlan.parse("nan_grad@banana=1")
+    with pytest.raises(ValueError, match="malformed"):
+        FaultPlan.parse("nan_grad@step")
+
+
+# ------------------------------------------------------------ nan_grad guard
+def test_nan_grad_step_skipped_and_counted(tmp_path):
+    with faults.injected("nan_grad@step=3"):
+        t = make_trainer(tmp_path, epochs=2)
+        t.fit()
+    assert t.history["skipped_steps"] == [1, 0]
+    assert all(np.isfinite(v) for v in t.history["train_loss"])
+    assert all(
+        np.all(np.isfinite(leaf)) for leaf in jax.tree.leaves(t.state.params)
+    )
+    assert int(jax.device_get(t.state.skipped_steps)) == 1
+
+
+def test_guard_off_vs_on_identical_trajectory(tmp_path):
+    """With all-finite math the guard's where-selects are exact no-ops:
+    guarded and unguarded runs produce bit-identical params."""
+    a = make_trainer(tmp_path / "a", epochs=1)
+    a.fit()
+    b = make_trainer(tmp_path / "b", epochs=1, nonfinite_guard=False)
+    b.fit()
+    assert a.train_losses == b.train_losses
+    assert params_equal(a.state.params, b.state.params)
+
+
+def test_rollback_after_consecutive_bad_steps(tmp_path):
+    """K consecutive non-finite steps trigger restore-from-last-good plus
+    LR backoff (checked at the log_every sync cadence)."""
+    with faults.injected("nan_grad@step=5,count=3"):
+        t = make_trainer(
+            tmp_path, epochs=2, save_every_steps=1, rollback_bad_steps=2,
+        )
+        t.log_every = 1  # check the streak at every step
+        t.fit()
+    assert t._lr_scale == pytest.approx(0.5)  # one rollback, one backoff
+    assert sum(t.history["skipped_steps"]) >= 2
+    assert all(np.isfinite(v) for v in t.history["train_loss"])
+
+
+# ----------------------------------------------------------- preempt/resume
+def test_preempt_resume_bit_exact_mid_epoch(tmp_path):
+    """THE acceptance scenario: preemption mid-epoch-2, then resume —
+    history and final params bit-identical to the uninterrupted run."""
+    ref = make_trainer(tmp_path / "ref", epochs=2)
+    ref.fit()
+
+    d = tmp_path / "pre"
+    with faults.injected("preempt@step=6"):  # batch 2 of epoch 2 (4/epoch)
+        t1 = make_trainer(d, epochs=2, save_every_steps=2)
+        t1.fit()
+    assert t1.preempted
+    assert len(t1.train_losses) == 1  # the partial epoch recorded nothing
+    marker = os.path.join(str(d), "checkpoints", "PREEMPTED.json")
+    assert os.path.exists(marker)
+    assert json.load(open(marker))["epoch"] == 2
+
+    t2 = make_trainer(d, epochs=2, save_every_steps=2)
+    t2.fit(resume=True)
+    assert not os.path.exists(marker)  # consumed on resume
+    assert t2.history["epochs"] == ref.history["epochs"]
+    assert t2.history["train_loss"] == ref.history["train_loss"]
+    assert t2.history["val_loss"] == ref.history["val_loss"]
+    assert params_equal(ref.state.params, t2.state.params)
+
+
+def test_sigterm_requests_clean_preemption(tmp_path):
+    """A real SIGTERM takes the same path as the injected fault: finish
+    the step, emergency-checkpoint, exit fit() with preempted=True."""
+    import signal
+
+    t = make_trainer(tmp_path, epochs=50, size=256, save_every_steps=4)
+    timer = threading.Timer(
+        1.5, lambda: os.kill(os.getpid(), signal.SIGTERM)
+    )
+    timer.start()
+    try:
+        t.fit()
+    finally:
+        timer.cancel()
+    assert t.preempted
+    assert os.path.exists(
+        os.path.join(str(tmp_path), "checkpoints", "PREEMPTED.json")
+    )
+    # Handlers restored after fit (or pytest's SIGTERM handling breaks).
+    assert signal.getsignal(signal.SIGTERM) != t._on_preempt_signal
+
+
+def test_save_every_steps_requires_per_batch_dispatch(tmp_path):
+    with pytest.raises(ValueError, match="steps_per_execution"):
+        make_trainer(tmp_path, save_every_steps=2, steps_per_execution=3)
+
+
+# ------------------------------------------------------- checkpoint integrity
+def make_ckpt_state(seed=0):
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.ops import get_optimizer
+    from ml_trainer_tpu.train_state import TrainState
+    import jax.numpy as jnp
+
+    model = get_model("gpt2_tiny")
+    variables = model.init(
+        {"params": jax.random.PRNGKey(seed)}, jnp.ones((1, 16), jnp.int32),
+        train=False,
+    )
+    tx = get_optimizer("adamw", 1e-3)
+    params = variables["params"]
+    return TrainState(
+        step=jnp.asarray(7, jnp.int32), params=params,
+        opt_state=tx.init(params), batch_stats={},
+        rng=jax.random.PRNGKey(1),
+    )
+
+
+def test_ckpt_truncate_quarantined_and_fallback(tmp_path):
+    """The injected truncation passes the commit rename but fails CRC:
+    latest_valid_checkpoint quarantines it and falls back."""
+    state = make_ckpt_state()
+    good = ckpt.save_checkpoint(str(tmp_path), state, {"train_loss": [1.0]},
+                                epoch=1)
+    with faults.injected("ckpt_truncate@epoch=2"):
+        bad = ckpt.save_checkpoint(
+            str(tmp_path), state, {"train_loss": [1.0, 0.5]}, epoch=2
+        )
+    # The corrupt checkpoint is committed (manifest present) but invalid.
+    assert os.path.exists(os.path.join(bad, "manifest.json"))
+    with pytest.raises(CheckpointCorrupt, match="CRC32"):
+        ckpt.verify_checkpoint(bad)
+    assert ckpt.latest_checkpoint(str(tmp_path)) == bad  # naive scan bites
+    assert ckpt.latest_valid_checkpoint(str(tmp_path)) == good
+    assert os.path.isdir(bad + ".corrupt")  # quarantined out of the scan
+    assert not os.path.exists(bad)
+    restored, hist, epoch = ckpt.restore_checkpoint(
+        good, ckpt.fetch_to_host(make_ckpt_state(seed=9))
+    )
+    assert epoch == 1 and hist["train_loss"] == [1.0]
+    assert params_equal(state.params, restored.params)
+
+
+def test_restore_raises_on_crc_mismatch(tmp_path):
+    state = make_ckpt_state()
+    path = ckpt.save_checkpoint(str(tmp_path), state, {}, epoch=1)
+    leaves = [f for f in os.listdir(path) if f.endswith(".npy")]
+    victim = os.path.join(path, sorted(leaves)[-1])
+    with open(victim, "r+b") as fp:
+        fp.truncate(os.path.getsize(victim) // 2)
+    with pytest.raises(CheckpointCorrupt, match="CRC32"):
+        ckpt.restore_checkpoint(
+            path, ckpt.fetch_to_host(make_ckpt_state(seed=3))
+        )
+
+
+def test_trainer_resume_falls_back_past_corrupt_checkpoint(tmp_path):
+    """fit(resume=True) with a corrupt newest checkpoint quarantines it
+    and resumes from the previous epoch instead of crashing."""
+    t1 = make_trainer(tmp_path, epochs=2)
+    t1.fit()
+    ckpt_dir = os.path.join(str(tmp_path), "checkpoints")
+    newest = ckpt.latest_checkpoint(ckpt_dir)
+    assert newest.endswith("checkpoint_2")
+    leaves = [f for f in os.listdir(newest) if f.endswith(".npy")]
+    with open(os.path.join(newest, sorted(leaves)[-1]), "r+b") as fp:
+        fp.truncate(1)
+    t2 = make_trainer(tmp_path, epochs=3)
+    t2.fit(resume=True)
+    assert os.path.isdir(newest + ".corrupt")
+    # Fell back to epoch 1's checkpoint: epochs 2 and 3 re-trained.
+    assert t2.history["epochs"] == [1, 2, 3]
+    assert all(np.isfinite(v) for v in t2.history["train_loss"])
+
+
+def test_prune_never_deletes_newest_committed_with_inflight_write(tmp_path):
+    """Regression (satellite): an uncommitted mid-flight directory (v3
+    writes shard files before the commit manifest) must not count toward
+    ``keep`` — with keep=1 the newest COMMITTED checkpoint survives."""
+    state = make_ckpt_state()
+    for e in (1, 2, 3):
+        ckpt.save_checkpoint(str(tmp_path), state, {}, epoch=e, keep=0)
+    # Simulate a newer write mid-flight: committed manifest not yet there.
+    inflight = os.path.join(str(tmp_path), "checkpoint_4")
+    os.makedirs(inflight)
+    with open(os.path.join(inflight, "leaf_00000_s0_p00000.npy"), "wb") as f:
+        f.write(b"\x93NUMPY partial")
+    ckpt.prune_checkpoints(str(tmp_path), keep=1)
+    assert not os.path.exists(os.path.join(str(tmp_path), "checkpoint_1"))
+    assert not os.path.exists(os.path.join(str(tmp_path), "checkpoint_2"))
+    # Newest committed survives; the in-flight dir is untouched debris.
+    assert os.path.exists(os.path.join(str(tmp_path), "checkpoint_3"))
+    assert os.path.exists(inflight)
+    assert ckpt.latest_checkpoint(str(tmp_path)).endswith("checkpoint_3")
+
+
+# ------------------------------------------------------------- native loader
+def test_native_loader_decode_error_fault(tmp_path):
+    from ml_trainer_tpu.data.native import NativeLoader, native_available
+
+    if not native_available():
+        pytest.skip("native batch worker unavailable (no g++)")
+    ds = SyntheticCIFAR10(size=32, seed=0)
+    loader = NativeLoader(ds, batch_size=16, shuffle=False, seed=0)
+    with faults.injected("decode_error@epoch=0"):
+        with pytest.raises(RuntimeError, match="failed JPEG decode"):
+            list(loader)
+    loader.set_epoch(1)  # next epoch: fault consumed, loader healthy
+    assert len(list(loader)) == 2
+    loader.stop()
+
+
+# ------------------------------------------------------------------- serving
+@pytest.fixture(scope="module")
+def served_model():
+    from ml_trainer_tpu.models import get_model
+
+    model = get_model("gpt2_tiny", max_len=64)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables
+
+
+def _prompt(seed, n):
+    return np.asarray(
+        np.random.default_rng(seed).integers(0, 1024, n), np.int32
+    )
+
+
+def test_decode_wedge_watchdog_fails_clients_fast(served_model):
+    """A wedged decode step must fail every waiting client with a
+    structured error (never hang), mark the server unhealthy, and refuse
+    new admissions."""
+    from ml_trainer_tpu.serving import EngineUnhealthy, Server
+
+    model, variables = served_model
+    # Warm the compiled programs (process-global LRU) through a throwaway
+    # watchdog-less server: first-hit compiles run on the engine loop
+    # thread and would trip a 1s watchdog as a false positive.
+    with Server(model, variables, max_batch=2, watchdog_timeout=None) as w:
+        w.complete(_prompt(0, 5), 2, timeout=120)
+    with faults.injected("decode_wedge@step=8,secs=120") as plan:
+        srv = Server(model, variables, max_batch=2, watchdog_timeout=1.0)
+        try:
+            s = srv.submit(_prompt(1, 5), 32)
+            t0 = time.monotonic()
+            with pytest.raises(RuntimeError, match="wedged"):
+                s.result(timeout=60)
+            assert time.monotonic() - t0 < 30  # failed fast, not hung
+            health = srv.health()
+            assert not health["ok"] and "wedged" in health["reason"]
+            with pytest.raises(EngineUnhealthy, match="wedged"):
+                srv.submit(_prompt(2, 4), 4)
+            assert srv.metrics.snapshot()["watchdog_trips"] == 1
+        finally:
+            plan.release_wedge()
+            srv.close()
+
+
+def test_engine_thread_death_propagates_to_streams(served_model):
+    """Satellite: if the engine thread dies, every waiting result()/
+    iterator gets the exception instead of blocking forever."""
+    from ml_trainer_tpu.serving import EngineUnhealthy, Server
+
+    model, variables = served_model
+    srv = Server(model, variables, max_batch=2, watchdog_timeout=None)
+    try:
+        srv.complete(_prompt(3, 4), 2, timeout=120)  # warm
+
+        class Boom(BaseException):  # dodges the loop's except Exception
+            pass
+
+        def die(*a, **kw):
+            raise Boom("engine exploded")
+
+        srv.engine.step = die
+        s = srv.submit(_prompt(4, 4), 8)
+        with pytest.raises(RuntimeError, match="engine thread died"):
+            s.result(timeout=60)
+        with pytest.raises(EngineUnhealthy):
+            srv.submit(_prompt(5, 4), 4)
+        assert not srv.health()["healthy"]
+    finally:
+        srv.close()
+
+
+def test_result_timeout_honored_when_engine_dead(served_model):
+    """Satellite: blocking result() honors its timeout even when the
+    engine is silently stuck (watchdog disabled here on purpose)."""
+    from ml_trainer_tpu.serving import Server
+
+    model, variables = served_model
+    srv = Server(model, variables, max_batch=2, watchdog_timeout=None)
+    release = threading.Event()
+    try:
+        srv.complete(_prompt(6, 4), 2, timeout=120)  # warm
+
+        def stuck(*a, **kw):
+            release.wait(60)
+            return []
+
+        srv.engine.step = stuck
+        s = srv.submit(_prompt(7, 4), 8)
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError, match="not finished within"):
+            s.result(timeout=0.5)
+        assert time.monotonic() - t0 < 5
+    finally:
+        release.set()
+        srv.close()
+
+
+def test_drain_stops_admission_and_finishes_inflight(served_model):
+    from ml_trainer_tpu.serving import AdmissionError, Server
+
+    model, variables = served_model
+    srv = Server(model, variables, max_batch=2)
+    try:
+        srv.complete(_prompt(8, 4), 2, timeout=120)  # warm
+        streams = [srv.submit(_prompt(9 + i, 4), 6) for i in range(3)]
+        assert srv.drain(timeout=120)
+        with pytest.raises(AdmissionError, match="draining"):
+            srv.submit(_prompt(12, 4), 4)
+        for s in streams:  # drained means FINISHED, not dropped
+            assert len(s.result(timeout=10)) == 10
+        health = srv.health()
+        assert health["draining"] and health["healthy"] and not health["ok"]
+    finally:
+        srv.close()
+
+
+def test_healthz_reports_unhealthy_with_503(served_model):
+    """The HTTP surface of the watchdog: /healthz flips to 503 with the
+    wedge reason once the watchdog trips."""
+    import urllib.error
+    import urllib.request
+
+    from ml_trainer_tpu.serving import Server
+
+    model, variables = served_model
+    with Server(model, variables, max_batch=2, watchdog_timeout=None) as w:
+        w.complete(_prompt(20, 5), 2, timeout=120)  # warm (see wedge test)
+    with faults.injected("decode_wedge@step=6,secs=120") as plan:
+        srv = Server(model, variables, max_batch=2, watchdog_timeout=1.0)
+        try:
+            host, port = srv.serve_http(port=0)
+            base = f"http://{host}:{port}"
+            with urllib.request.urlopen(f"{base}/healthz", timeout=30) as r:
+                assert json.loads(r.read())["ok"] is True
+            s = srv.submit(_prompt(21, 5), 32)
+            with pytest.raises(RuntimeError):
+                s.result(timeout=60)
+            try:
+                urllib.request.urlopen(f"{base}/healthz", timeout=30)
+                raise AssertionError("healthz should be 503 when wedged")
+            except urllib.error.HTTPError as e:
+                assert e.code == 503
+                payload = json.loads(e.read())
+                assert payload["healthy"] is False
+                assert "wedged" in payload["reason"]
+        finally:
+            plan.release_wedge()
+            srv.close()
+
+
+# ------------------------------------------------------------- slow matrix
+@pytest.mark.slow
+def test_chaos_matrix_combined_run(tmp_path):
+    """The full storm in one training run: NaN steps, preemption and a
+    corrupted checkpoint across epochs — the run still converges to the
+    uninterrupted trajectory's epoch count with finite history."""
+    ref = make_trainer(tmp_path / "ref", epochs=3, size=128)
+    ref.fit()
+
+    d = tmp_path / "storm"
+    # Epoch 1 (8 steps/epoch): one NaN step.  Epoch 2: preempted at
+    # step 12 (batch 4).  The epoch-1 checkpoint gets truncated AFTER
+    # resume consumed the emergency checkpoint (quarantine fallback is
+    # separately covered; here it proves CRC tolerates live traffic).
+    with faults.injected("nan_grad@step=3;preempt@step=12"):
+        t1 = make_trainer(d, epochs=3, size=128, save_every_steps=2)
+        t1.fit()
+    assert t1.preempted and t1.history["skipped_steps"] == [1]
+    t2 = make_trainer(d, epochs=3, size=128, save_every_steps=2)
+    t2.fit(resume=True)
+    assert t2.history["epochs"] == [1, 2, 3]
+    assert t2.history["skipped_steps"] == [1, 0, 0]
+    assert all(np.isfinite(v) for v in t2.history["train_loss"])
+    # The NaN-skipped epoch diverges from ref by the skipped update, but
+    # epochs all completed and the state is healthy/finite.
+    assert all(
+        np.all(np.isfinite(leaf))
+        for leaf in jax.tree.leaves(t2.state.params)
+    )
+
+
+@pytest.mark.slow
+def test_preempt_resume_bit_exact_with_metric_and_ema(tmp_path):
+    """Bit-exact mid-epoch resume composes with EMA weights and a metric
+    (both live in the checkpointed state/accumulators)."""
+    def mk(p, **kw):
+        tr = custom_pre_process_function()
+        return Trainer(
+            MLModel(),
+            datasets=(SyntheticCIFAR10(size=64, seed=0, transform=tr),
+                      SyntheticCIFAR10(size=32, seed=1, transform=tr)),
+            epochs=2, batch_size=16, model_dir=str(p), metric="accuracy",
+            lr=0.01, ema_decay=0.9, **kw,
+        )
+
+    ref = mk(tmp_path / "ref")
+    ref.fit()
+    d = tmp_path / "pre"
+    with faults.injected("preempt@step=7"):
+        mk(d, save_every_steps=1).fit()
+    t2 = mk(d, save_every_steps=1)
+    t2.fit(resume=True)
+    assert t2.history["train_loss"] == ref.history["train_loss"]
+    assert t2.history["train_metric"] == ref.history["train_metric"]
+    assert params_equal(ref.state.params, t2.state.params)
+    assert params_equal(ref.state.ema_params, t2.state.ema_params)

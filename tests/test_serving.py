@@ -210,7 +210,8 @@ def test_http_front_end_round_trip(model_and_vars):
         with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
             snap = json.loads(resp.read())
         with urllib.request.urlopen(f"{base}/healthz", timeout=30) as resp:
-            assert json.loads(resp.read()) == {"ok": True}
+            health = json.loads(resp.read())
+            assert health["ok"] is True and health["healthy"] is True
     np.testing.assert_array_equal(np.asarray(out["tokens"], np.int32), ref)
     assert snap["requests_completed"] >= 1
 

@@ -60,7 +60,7 @@ def test_v3_roundtrip_and_layout(tmp_path):
     by_path = {tuple(m["path"]): i for i, m in enumerate(manifest["leaves"])}
     mu_pieces = tables[by_path[("opt_state", "mu")]]
     assert len(mu_pieces) == 8
-    assert all(stop[0] - start[0] == 2 for start, stop, _ in mu_pieces)
+    assert all(stop[0] - start[0] == 2 for start, stop, _, _crc in mu_pieces)
     assert len(tables[by_path[("params", "w")]]) == 1
 
     # Host-array restore (no shardings).

@@ -43,15 +43,17 @@ def test_fit_produces_history_schema(tmp_path):
     trainer = make_trainer(tmp_path)
     trainer.fit()
     h = trainer.history
-    # Exact schema parity (ref: src/trainer.py:265-272).
+    # Exact schema: reference parity keys (ref: src/trainer.py:265-272)
+    # plus the resilience layer's per-epoch skipped-step counts.
     assert set(h) == {
         "epochs", "train_loss", "val_loss", "train_metric", "val_metric",
-        "metric_type",
+        "metric_type", "skipped_steps",
     }
     assert h["epochs"] == [1, 2]
     assert len(h["train_loss"]) == 2 and len(h["val_metric"]) == 2
     assert h["metric_type"] == "accuracy"
     assert all(np.isfinite(v) for v in h["train_loss"])
+    assert h["skipped_steps"] == [0, 0]  # healthy run: guard skipped nothing
 
 
 def test_loss_decreases_on_learnable_data(tmp_path):
