@@ -150,6 +150,18 @@ def _apply_ckpt_faults(final_dir: str, epoch: int) -> None:
 def _write_checkpoint_dir(
     final_dir: str, state_dict: Any, history: dict, epoch: int
 ) -> None:
+    # The ACTUAL checkpoint I/O (often on the async writer thread): the
+    # span shows on the Perfetto timeline whether the write hides behind
+    # the next epoch or stalls it (telemetry/spans.py).
+    from ml_trainer_tpu.telemetry.spans import span as _span
+
+    with _span("ckpt_write_io", epoch=epoch, dir=os.path.basename(final_dir)):
+        _write_checkpoint_dir_inner(final_dir, state_dict, history, epoch)
+
+
+def _write_checkpoint_dir_inner(
+    final_dir: str, state_dict: Any, history: dict, epoch: int
+) -> None:
     tmp_dir = final_dir + ".tmp"
     if os.path.isdir(tmp_dir):
         shutil.rmtree(tmp_dir)

@@ -144,11 +144,26 @@ def prefetch_to_device(
             lambda a: jax.device_put(a, fit_rank(a, sharding)), batch
         )
 
+    # Host spans (telemetry/spans.py): data_load is the host assembling
+    # the next batch, h2d its device placement — on the Perfetto
+    # timeline these show whether the input pipeline hides behind the
+    # step or the step waits on it.
+    from ml_trainer_tpu.telemetry.spans import span
+
     it = iter(iterator)
+
+    def load_next():
+        with span("data_load"):
+            return next(it, None)
+
+    def put_spanned(batch):
+        with span("h2d"):
+            return put(batch)
+
     for batch in itertools.islice(it, size):
-        queue.append(put(batch))
+        queue.append(put_spanned(batch))
     while queue:
         yield queue.popleft()
-        batch = next(it, None)
+        batch = load_next()
         if batch is not None:
-            queue.append(put(batch))
+            queue.append(put_spanned(batch))

@@ -134,11 +134,22 @@ class FaultPlan:
     def fire(self, kind: str, *, step: Optional[int] = None,
              epoch: Optional[int] = None) -> Optional[Fault]:
         with self._lock:
+            fired = None
             for fault in self.faults:
                 if fault.kind == kind and fault.matches(step, epoch):
                     fault.fired += 1
-                    return fault
-        return None
+                    fired = fault
+                    break
+        if fired is not None:
+            # The injection itself goes on the flight-recorder timeline:
+            # a chaos dump then shows the fault next to the step records
+            # it poisoned (telemetry/flight.py).
+            from ml_trainer_tpu.telemetry.flight import get_recorder
+
+            get_recorder().record(
+                "fault_injected", fault=fired.spec(), step=step, epoch=epoch
+            )
+        return fired
 
     # -- wedge latch (decode_wedge) -------------------------------------
     def hold_wedge(self, fault: Fault) -> None:

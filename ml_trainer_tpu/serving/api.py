@@ -12,9 +12,14 @@ driving the admit/step loop.  Callers interact through:
   (constructor flag ``http_port`` or an explicit call): POST
   ``/v1/generate`` with ``{"prompt": [ids...], "max_new_tokens": n,
   "temperature": t?, "seed": s?, "eos_token_id": e?, "deadline": d?}``
-  returns ``{"tokens": [...]}``; GET ``/metrics`` returns the serving
-  metrics snapshot; GET ``/healthz`` liveness/health (503 when wedged or
-  draining).  Backpressure maps to HTTP 429, deadlines to 504.
+  returns ``{"tokens": [...]}``; GET ``/metrics`` serves Prometheus
+  text exposition of the process telemetry registry (serving gauges
+  freshly published — what a scraper points at); GET ``/metrics.json``
+  keeps the flat JSON snapshot shape; GET ``/healthz`` liveness/health
+  (503 when wedged or draining); POST ``/admin/profile``
+  ``{"steps": K, "logdir"?: ...}`` arms an on-demand ``jax.profiler``
+  window over the next K decode steps (telemetry/spans.py).
+  Backpressure maps to HTTP 429, deadlines to 504.
 
 Failure contract (docs/resilience.md): clients NEVER hang on a dead
 engine.  A watchdog thread monitors the loop's heartbeat; a decode step
@@ -320,13 +325,23 @@ class Server:
     def _mark_unhealthy(self, reason: str) -> None:
         """Declare the engine dead/wedged: stop admission, fail every
         waiting client with a structured error (never hang), surface the
-        reason through ``health()``/``/healthz``.  Idempotent."""
+        reason through ``health()``/``/healthz``, and dump the flight
+        recorder — its newest ``decode_step`` record names the engine
+        step that wedged.  Idempotent."""
         with self._health_lock:
             if not self.healthy:
                 return
             self.healthy = False
             self._unhealthy_reason = reason
         self._log.error("serving_unhealthy", reason=reason)
+        from ml_trainer_tpu.telemetry.flight import get_recorder
+
+        get_recorder().dump(
+            f"serving_unhealthy: {reason}",
+            engine_step=self.engine._step_seq,
+            active_requests=self.engine.active_count(),
+            queued_requests=self.scheduler.queue_depth(),
+        )
         self._fail_all(f"serving engine unhealthy: {reason}",
                        release_slots=False)
         self._wake.set()
@@ -449,6 +464,14 @@ class Server:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _send_text(self, code: int, text: str, content_type: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     payload = server.health()
@@ -456,11 +479,44 @@ class Server:
                     # routing here; the payload says why.
                     self._send(200 if payload["ok"] else 503, payload)
                 elif self.path == "/metrics":
+                    # Prometheus text exposition of the WHOLE process
+                    # registry (trainer gauges included when co-resident),
+                    # with the serving snapshot published fresh.
+                    from ml_trainer_tpu.telemetry.registry import (
+                        default_registry,
+                    )
+
+                    registry = default_registry()
+                    server.metrics.publish(registry)
+                    self._send_text(
+                        200, registry.prometheus_text(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                elif self.path == "/metrics.json":
                     self._send(200, server.metrics.snapshot())
                 else:
                     self._send(404, {"error": "not found"})
 
             def do_POST(self):
+                if self.path == "/admin/profile":
+                    try:
+                        n = int(self.headers.get("Content-Length", 0))
+                        body = json.loads(self.rfile.read(n) or b"{}")
+                        armed = server.engine._profiler.request(
+                            int(body.get("steps", 10)),
+                            body.get("logdir"),
+                        )
+                        self._send(
+                            200 if armed else 409,
+                            {"armed": armed,
+                             "steps": int(body.get("steps", 10))},
+                        )
+                    except (TypeError, ValueError,
+                            json.JSONDecodeError) as e:
+                        self._send(
+                            400, {"error": f"{type(e).__name__}: {e}"}
+                        )
+                    return
                 if self.path != "/v1/generate":
                     self._send(404, {"error": "not found"})
                     return

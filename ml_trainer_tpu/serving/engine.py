@@ -61,6 +61,8 @@ import numpy as np
 from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, _empty_cache
 from ml_trainer_tpu.serving.metrics import ServingMetrics
 from ml_trainer_tpu.serving.scheduler import Request
+from ml_trainer_tpu.telemetry.flight import get_recorder
+from ml_trainer_tpu.telemetry.spans import StepProfiler, span
 from ml_trainer_tpu.speculative import (
     DraftModelDrafter,
     NgramDrafter,
@@ -143,6 +145,11 @@ class SlotDecodeEngine:
         self._steps = np.zeros((max_batch,), np.int32)
         self._active: Dict[int, Request] = {}
         self._step_seq = 0  # decode steps run (the decode_wedge fault clock)
+        # Telemetry: flight ring for crash forensics (the watchdog dumps
+        # it when the loop wedges) and the on-demand profile window the
+        # admin endpoint arms (POST /admin/profile).
+        self._flight = get_recorder()
+        self._profiler = StepProfiler("serve")
 
         self._decode = self._program(
             ("serve_decode", model, max_batch), self._build_decode
@@ -309,13 +316,15 @@ class SlotDecodeEngine:
             lambda: self._build_prefill(bucket),
         )
         t0 = time.perf_counter()
-        cache1, tok0 = run(
-            self.params, padded, np.int32(p),
-            jnp.asarray(req.temperature, jnp.float32), key,
-        )
-        self.cache, self.tok = self._insert(
-            self.cache, self.tok, cache1, tok0, np.int32(slot), np.int32(p)
-        )
+        with span("serve_prefill", prompt_len=p, bucket=bucket, slot=slot):
+            cache1, tok0 = run(
+                self.params, padded, np.int32(p),
+                jnp.asarray(req.temperature, jnp.float32), key,
+            )
+            self.cache, self.tok = self._insert(
+                self.cache, self.tok, cache1, tok0, np.int32(slot),
+                np.int32(p)
+            )
         if self.spec_k:
             self._pos[slot] = p
             self._caps[slot] = min(
@@ -371,6 +380,14 @@ class SlotDecodeEngine:
         if not self._active:
             return []
         self._step_seq += 1
+        # Flight record BEFORE the dispatch: when this step wedges, the
+        # ring's newest decode_step record names the step the watchdog
+        # dump blames.
+        self._flight.record(
+            "decode_step", engine_step=self._step_seq,
+            active=len(self._active), spec=bool(self.spec_k),
+        )
+        self._profiler.on_step(self._step_seq)
         # decode_wedge injection hook (resilience/faults.py): block like a
         # wedged device program would — the serving watchdog's job is to
         # fail the waiting clients while this thread is stuck here.
@@ -385,11 +402,13 @@ class SlotDecodeEngine:
             return self._step_spec()
         active_before = len(self._active)
         t0 = time.perf_counter()
-        self.cache, self.tok = self._decode(
-            self.params, self.cache, self.tok,
-            self._temps, self._rngs, self._steps,
-        )
-        toks = np.asarray(self.tok[:, 0])  # blocks until the step lands
+        with span("serve_decode", engine_step=self._step_seq,
+                  active=active_before):
+            self.cache, self.tok = self._decode(
+                self.params, self.cache, self.tok,
+                self._temps, self._rngs, self._steps,
+            )
+            toks = np.asarray(self.tok[:, 0])  # blocks: the step landed
         dt = time.perf_counter() - t0
         freed: List[int] = []
         emitted = 0
@@ -426,32 +445,36 @@ class SlotDecodeEngine:
         active_before = len(self._active)
         k = self.spec_k
         t0 = time.perf_counter()
-        if self._draft is not None:
-            self._draft_cache, drafts_dev = self._draft_scan(
-                self._draft.params, self._draft_cache, self.tok,
-                jnp.asarray(self._pos),
+        with span("serve_decode_spec", engine_step=self._step_seq,
+                  active=active_before, k=k):
+            if self._draft is not None:
+                self._draft_cache, drafts_dev = self._draft_scan(
+                    self._draft.params, self._draft_cache, self.tok,
+                    jnp.asarray(self._pos),
+                )
+                drafts = np.asarray(drafts_dev)
+            else:
+                # Per-slot draft state: the lookup history is the
+                # request's own prompt + committed tokens.  Inactive
+                # slots draft zeros — their rows compute masked garbage
+                # nobody reads.
+                drafts = np.zeros((self.max_batch, k), np.int32)
+                for slot, req in self._active.items():
+                    hist = np.concatenate([
+                        np.asarray(req.prompt, np.int32).reshape(-1),
+                        np.asarray(req.tokens, np.int32),
+                    ])
+                    drafts[slot] = self._ngram.draft_one(hist)
+            window = jnp.concatenate(
+                [self.tok, jnp.asarray(drafts, jnp.int32)], axis=1
             )
-            drafts = np.asarray(drafts_dev)
-        else:
-            # Per-slot draft state: the lookup history is the request's
-            # own prompt + committed tokens.  Inactive slots draft
-            # zeros — their rows compute masked garbage nobody reads.
-            drafts = np.zeros((self.max_batch, k), np.int32)
-            for slot, req in self._active.items():
-                hist = np.concatenate([
-                    np.asarray(req.prompt, np.int32).reshape(-1),
-                    np.asarray(req.tokens, np.int32),
-                ])
-                drafts[slot] = self._ngram.draft_one(hist)
-        window = jnp.concatenate(
-            [self.tok, jnp.asarray(drafts, jnp.int32)], axis=1
-        )
-        self.cache, accepted, self.tok, _ = self._verify(
-            self.params, self.cache, window, jnp.asarray(self._pos),
-            jnp.asarray(self._caps), self._temps, self._rngs, self._steps,
-        )
-        acc = np.asarray(accepted)
-        toks = np.asarray(self.tok[:, 0])  # blocks until the step lands
+            self.cache, accepted, self.tok, _ = self._verify(
+                self.params, self.cache, window, jnp.asarray(self._pos),
+                jnp.asarray(self._caps), self._temps, self._rngs,
+                self._steps,
+            )
+            acc = np.asarray(accepted)
+            toks = np.asarray(self.tok[:, 0])  # blocks: the step landed
         dt = time.perf_counter() - t0
         freed: List[int] = []
         emitted = 0

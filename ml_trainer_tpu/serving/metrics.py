@@ -15,7 +15,18 @@ dependency — the container is zero-egress):
 
 Exported through ``utils/logging.py``: ``ServingMetrics.log()`` emits one
 structured ``serving_metrics`` event with the snapshot as key-values, so
-the serving process logs in the same shape as the trainer.
+the serving process logs in the same shape as the trainer — and through
+the telemetry spine: ``publish()`` mirrors the snapshot into the
+process-wide metrics registry (``telemetry/registry.py``) as
+``serving_*`` gauges, which is what the HTTP front end's ``/metrics``
+serves as Prometheus text exposition (the JSON shape stays available at
+``/metrics.json``).
+
+Concurrency contract (hammer-tested in tests/test_serving.py): every
+``record_*`` and ``snapshot()`` takes the one instance lock, every
+division in ``snapshot()`` is guarded against its empty-window /
+zero-denominator edge, so concurrent recording and scraping can never
+crash the scrape.
 """
 
 from __future__ import annotations
@@ -37,6 +48,11 @@ class ServingMetrics:
     """Thread-safe rolling serving metrics (bounded windows)."""
 
     def __init__(self, window: int = 2048):
+        if window < 1:
+            # deque(maxlen=0) silently discards every observation — a
+            # scrape would then report all-zero latencies while traffic
+            # flows, which reads as an outage that is not happening.
+            raise ValueError(f"window must be >= 1, got {window}")
         self._lock = threading.Lock()
         self._ttft = collections.deque(maxlen=window)
         self._prefill_secs = collections.deque(maxlen=window)
@@ -210,4 +226,27 @@ class ServingMetrics:
             logger = get_logger("ml_trainer_tpu.serving")
         snap = self.snapshot()
         logger.info("serving_metrics", **snap)
+        return snap
+
+    def publish(self, registry=None) -> dict:
+        """Mirror the snapshot into the telemetry registry as
+        ``serving_*`` gauges (the spec acceptance histogram becomes a
+        labeled gauge), and return the snapshot.  Gauges, not counters:
+        the snapshot is a point-in-time view and several of its fields
+        legally move both ways (queue depth, occupancy)."""
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        r = registry if registry is not None else default_registry()
+        snap = self.snapshot()
+        for key, value in snap.items():
+            if key == "spec_accept_hist":
+                g = r.gauge(
+                    "serving_spec_accept_hist",
+                    "verify steps by accepted-draft count",
+                    ("accepted",),
+                )
+                for a, c in value.items():
+                    g.labels(accepted=a).set(c)
+                continue
+            r.gauge(f"serving_{key}").set(float(value))
         return snap

@@ -1,0 +1,73 @@
+"""Unified telemetry spine — the one subsystem every layer reports into.
+
+Four parts (docs/observability.md):
+
+* **registry** — thread-safe counters / gauges / histograms with labels,
+  a process-wide default registry, Prometheus text exposition and a
+  JSONL sink (``registry.py`` / ``export.py``).  The trainer and the
+  serving stack both publish here, so one scrape endpoint (or one JSONL
+  tail) covers the whole process.
+* **train step telemetry** — grad-norm / param-norm / update-ratio
+  stats accumulated ON-DEVICE inside the compiled train step (same
+  no-host-sync discipline as the all-finite guard; zero extra compiled
+  programs), fetched at the trainer's existing ``log_every`` sync
+  cadence and emitted as structured events + registry gauges alongside
+  samples/s, tokens/s and an analytic MFU estimate
+  (``train_metrics.py`` + ``flops.py``).
+* **span tracing** — host-side spans emitting Chrome/Perfetto
+  trace-event JSON, composable with ``utils.profiler.annotate`` so host
+  spans and XLA device traces line up; plus on-demand ``jax.profiler``
+  windows triggered by env/file flag or the serving admin endpoint
+  (``spans.py``).
+* **flight recorder** — a bounded ring of the last N step records and
+  events, dumped to ``flight_<ts>.json`` on NaN-rollback, preemption,
+  watchdog trip, or unhandled exception — the crash forensics a
+  post-mortem needs when the logs are gone (``flight.py``).
+"""
+
+from ml_trainer_tpu.telemetry.export import JsonlSink, prometheus_text
+from ml_trainer_tpu.telemetry.flight import (
+    FLIGHT_DIR_ENV,
+    FlightRecorder,
+    get_recorder,
+)
+from ml_trainer_tpu.telemetry.flops import (
+    chip_peak_flops,
+    chip_peak_hbm_bytes,
+    train_step_flops,
+)
+from ml_trainer_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from ml_trainer_tpu.telemetry.spans import (
+    StepProfiler,
+    save_trace,
+    span,
+    trace_events,
+)
+from ml_trainer_tpu.telemetry.train_metrics import TrainTelemetry
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "prometheus_text",
+    "JsonlSink",
+    "span",
+    "save_trace",
+    "trace_events",
+    "StepProfiler",
+    "FlightRecorder",
+    "get_recorder",
+    "FLIGHT_DIR_ENV",
+    "chip_peak_flops",
+    "chip_peak_hbm_bytes",
+    "train_step_flops",
+    "TrainTelemetry",
+]

@@ -1,0 +1,129 @@
+"""Registry exporters: Prometheus text exposition and a JSONL sink.
+
+Prometheus exposition follows the text format version 0.0.4 — the shape
+``promtool check metrics`` accepts: ``# HELP`` / ``# TYPE`` headers,
+``name{label="value"} number`` samples, histograms as cumulative
+``_bucket{le=...}`` series plus ``_sum`` / ``_count``.  The JSONL sink
+is the zero-infra alternative: one flat JSON object per line, append-only,
+durable across crashes (the line is flushed per write), so offline
+tooling can ``jq`` a run without a metrics server.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from typing import Optional
+
+
+def _fmt_value(v: float) -> str:
+    v = float(v)
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def _labels_text(labelnames, key, extra=()) -> str:
+    pairs = [
+        f'{ln}="{_escape_label(str(lv))}"'
+        for ln, lv in zip(labelnames, key)
+    ]
+    pairs.extend(extra)
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def prometheus_text(registry) -> str:
+    """The whole registry in Prometheus text exposition format 0.0.4."""
+    lines = []
+    for m in registry.collect():
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {m.kind}")
+        for key, _ in sorted(m.series().items()):
+            if m.kind == "histogram":
+                h = m._get(key)
+                cum = 0
+                for ub, c in zip(m.buckets, h["buckets"]):
+                    cum += c
+                    le = _labels_text(
+                        m.labelnames, key, (f'le="{_fmt_value(ub)}"',)
+                    )
+                    lines.append(f"{m.name}_bucket{le} {cum}")
+                le = _labels_text(m.labelnames, key, ('le="+Inf"',))
+                lines.append(f"{m.name}_bucket{le} {h['count']}")
+                lt = _labels_text(m.labelnames, key)
+                lines.append(f"{m.name}_sum{lt} {_fmt_value(h['sum'])}")
+                lines.append(f"{m.name}_count{lt} {h['count']}")
+            else:
+                lt = _labels_text(m.labelnames, key)
+                lines.append(f"{m.name}{lt} {_fmt_value(m._get(key))}")
+    return "\n".join(lines) + "\n"
+
+
+class JsonlSink:
+    """Append-only JSONL metrics/events sink (thread-safe).
+
+    ``write(record)`` appends one timestamped JSON line;
+    ``write_registry(registry)`` appends the registry's flat snapshot.
+    The file handle is opened lazily and each line is flushed, so a
+    crashed process keeps every record written before the crash."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fp = None
+
+    def _handle(self):
+        if self._fp is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fp = open(self.path, "a", encoding="utf-8")
+        return self._fp
+
+    def write(self, record: dict, kind: str = "event") -> None:
+        row = {"ts": round(time.time(), 6), "kind": kind}
+        row.update(record)
+        line = json.dumps(row, default=str)
+        with self._lock:
+            fp = self._handle()
+            fp.write(line + "\n")
+            fp.flush()
+
+    def write_registry(self, registry) -> None:
+        self.write(registry.snapshot(), kind="metrics")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fp is not None:
+                self._fp.close()
+                self._fp = None
+
+
+_default_sink: Optional[JsonlSink] = None
+_sink_lock = threading.Lock()
+
+SINK_ENV = "ML_TRAINER_TPU_METRICS_JSONL"
+
+
+def default_sink() -> Optional[JsonlSink]:
+    """Process-wide JSONL sink, enabled by pointing the env var
+    ``ML_TRAINER_TPU_METRICS_JSONL`` at a file path; None when unset."""
+    global _default_sink
+    path = os.environ.get(SINK_ENV, "")
+    with _sink_lock:
+        if not path:
+            return None
+        if _default_sink is None or _default_sink.path != path:
+            _default_sink = JsonlSink(path)
+        return _default_sink

@@ -1,0 +1,127 @@
+"""Flight recorder: the last N step records and events, dumped on crash.
+
+Logs scroll away and metrics aggregate; what a post-mortem needs is the
+exact sequence of steps right before the failure.  The recorder is a
+bounded ring buffer of small dicts — ``record()`` is a locked deque
+append, cheap enough for per-step use — and ``dump()`` writes the whole
+ring plus the triggering reason to ``flight_<timestamp>.json``.
+
+Dump sites (wired by the owning layers):
+
+* trainer: NaN-rollback (``rollback_bad_steps`` tripped), preemption
+  exit, and unhandled exceptions escaping ``fit()``;
+* serving: watchdog trip and engine-thread death
+  (``Server._mark_unhealthy``).
+
+The dump directory resolves, in order: the ``out_dir`` argument, the
+``ML_TRAINER_TPU_FLIGHT_DIR`` env var, the recorder's ``default_dir``
+(the trainer sets its ``model_dir``), then the system temp dir — so
+chaos tests point everything at a tmpdir with one env var.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import tempfile
+import threading
+import time
+from typing import Optional
+
+from ml_trainer_tpu.utils.logging import get_logger
+
+logger = get_logger("ml_trainer_tpu.telemetry")
+
+FLIGHT_DIR_ENV = "ML_TRAINER_TPU_FLIGHT_DIR"
+
+
+class FlightRecorder:
+    """Bounded ring of telemetry records with crash-dump-to-JSON."""
+
+    def __init__(self, capacity: int = 256,
+                 default_dir: Optional[str] = None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self.default_dir = default_dir
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+        self.last_dump_path: Optional[str] = None
+
+    def record(self, kind: str, **data) -> None:
+        """Append one record (thread-safe, O(1), never raises on data —
+        non-JSON values are stringified at dump time)."""
+        with self._lock:
+            self._seq += 1
+            self._ring.append(
+                {"seq": self._seq, "t": round(time.time(), 6),
+                 "kind": kind, **data}
+            )
+
+    def records(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def _resolve_dir(self, out_dir: Optional[str]) -> str:
+        return (
+            out_dir
+            or os.environ.get(FLIGHT_DIR_ENV)
+            or self.default_dir
+            or tempfile.gettempdir()
+        )
+
+    def dump(self, reason: str, out_dir: Optional[str] = None,
+             **extra) -> Optional[str]:
+        """Write ``flight_<ts>.json`` with the ring + reason; returns the
+        path, or None when the write itself fails (a dump must never
+        take down the process it is documenting)."""
+        payload = {
+            "reason": reason,
+            "dumped_at": time.time(),
+            "pid": os.getpid(),
+            **extra,
+            "records": self.records(),
+        }
+        d = self._resolve_dir(out_dir)
+        path = os.path.join(
+            d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}.json"
+        )
+        try:
+            os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as fp:
+                json.dump(payload, fp, default=str)
+            os.replace(tmp, path)
+        except OSError as e:
+            logger.error(f"flight dump failed ({path}): {e}")
+            return None
+        self.last_dump_path = path
+        logger.warning(f"flight recorder dumped: {path} (reason: {reason})")
+        return path
+
+
+# -- process-wide default recorder --------------------------------------
+_default: Optional[FlightRecorder] = None
+_default_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = FlightRecorder()
+        return _default
+
+
+def reset_recorder() -> None:
+    """Tests only: drop the process-wide recorder (long-lived holders of
+    the old handle keep recording into it)."""
+    global _default
+    with _default_lock:
+        _default = None

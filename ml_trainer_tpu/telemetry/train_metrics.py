@@ -1,0 +1,202 @@
+"""Training step telemetry: on-device stats + host-side emission.
+
+The on-device half (:func:`step_stats`) runs INSIDE the compiled train
+step — the same no-host-sync discipline as the all-finite guard: the
+stats are pure functions of values the step already computes (grads,
+updates, params, loss), they where-select nothing and branch on nothing,
+so enabling them changes neither the trajectory nor the number of
+compiled programs.  The trainer fetches the returned scalars at its
+existing ``log_every`` sync cadence — by then the dispatch has long
+retired, so the fetch is a ready-value read, not a stall.
+
+The host half (:class:`TrainTelemetry`) turns one fetched stats dict
+into: registry gauges/counters (``train_*``), a structured
+``train_step_telemetry`` log event, a flight-recorder step record, and
+throughput derived metrics — samples/s, tokens/s (LM models), and an
+analytic MFU estimate (``flops.py``; TPU backend only — an MFU against
+a CPU has no denominator worth printing).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ml_trainer_tpu.telemetry import flight as _flight
+from ml_trainer_tpu.telemetry import flops as _flops
+from ml_trainer_tpu.telemetry.registry import default_registry
+from ml_trainer_tpu.utils.logging import get_logger
+
+logger = get_logger("ml_trainer_tpu.telemetry")
+
+STAT_KEYS = (
+    "loss_raw", "grad_norm", "param_norm", "update_norm", "update_ratio",
+)
+
+
+def _global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return jnp.sqrt(
+        sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves)
+    )
+
+
+def step_stats(loss, grads, updates, new_params) -> dict:
+    """On-device per-step stats (float32 scalars; call inside the jitted
+    step).  ``loss_raw`` is the PRE-guard loss, so a skipped step's NaN
+    is visible to telemetry even though the accumulators zero it."""
+    gn = _global_norm(grads)
+    un = _global_norm(updates)
+    pn = _global_norm(new_params)
+    return {
+        "loss_raw": jnp.asarray(loss, jnp.float32),
+        "grad_norm": gn,
+        "param_norm": pn,
+        "update_norm": un,
+        # The step-size-to-weight-scale ratio optimizer tuning watches;
+        # eps guards a zero-param probe model, not a real run.
+        "update_ratio": un / (pn + 1e-12),
+    }
+
+
+def zero_stats() -> dict:
+    """Host-side stats placeholder with the same keys (pre-first-sync)."""
+    return {k: jnp.zeros((), jnp.float32) for k in STAT_KEYS}
+
+
+class TrainTelemetry:
+    """Host-side emitter for one training run.
+
+    Construct once per ``fit()`` with the model + batch geometry, then
+    call :meth:`on_sync` at every host-sync point with the latest
+    on-device stats and the counters the trainer already tracks.  All
+    device values passed in are fetched here with ONE ``device_get``."""
+
+    def __init__(self, model: Any = None, model_name: str = "",
+                 global_batch: int = 0,
+                 batch_shape: Optional[Sequence[int]] = None,
+                 registry=None, flight=None, log=None):
+        self.registry = registry if registry is not None else default_registry()
+        self.flight = flight if flight is not None else _flight.get_recorder()
+        self.log = log if log is not None else logger
+        self.model_name = model_name or (
+            type(model).__name__ if model is not None else ""
+        )
+        self.global_batch = int(global_batch)
+        # tokens/sample for LM-shaped batches ([B, S] integer inputs).
+        self.tokens_per_sample = (
+            int(batch_shape[1])
+            if batch_shape is not None and len(batch_shape) == 2 else 0
+        )
+        self.flops_per_step = (
+            _flops.train_step_flops(model, batch_shape)
+            if model is not None and batch_shape is not None else None
+        )
+        self._on_tpu = jax.default_backend() == "tpu"
+        self._peak = _flops.chip_peak_flops() if self._on_tpu else None
+        self._last_sync_t: Optional[float] = None
+        self._last_sync_step = 0
+        self._last_skipped = 0
+        # Instruments (idempotent registration; shared default registry).
+        r = self.registry
+        self.g_loss = r.gauge("train_loss", "last fetched train-step loss")
+        self.g_grad = r.gauge("train_grad_norm", "global gradient L2 norm")
+        self.g_param = r.gauge("train_param_norm", "global parameter L2 norm")
+        self.g_upd = r.gauge("train_update_norm", "global update L2 norm")
+        self.g_ratio = r.gauge(
+            "train_update_ratio", "update norm / param norm"
+        )
+        self.g_sps = r.gauge("train_samples_per_sec",
+                             "throughput since the previous sync")
+        self.g_tps = r.gauge("train_tokens_per_sec",
+                             "token throughput (LM batches)")
+        self.g_mfu = r.gauge("train_mfu",
+                             "analytic model FLOPs utilization (TPU only)")
+        self.g_lr_scale = r.gauge("train_lr_scale",
+                                  "plateau/rollback LR backoff scale")
+        self.c_steps = r.counter("train_steps_total", "optimizer steps run")
+        self.c_skipped = r.counter(
+            "train_skipped_steps_total",
+            "steps skipped by the non-finite guard",
+        )
+        self.c_rollbacks = r.counter(
+            "train_rollbacks_total", "rollback-to-last-good events"
+        )
+
+    def on_sync(self, step: int, stats: dict, *, epoch: int = 0,
+                skipped_total: int = 0, lr_scale: float = 1.0) -> dict:
+        """One sync point: fetch ``stats`` (device scalars), update the
+        registry, emit the structured event + flight record.  Returns
+        the fetched host-side dict (for the caller's own display)."""
+        now = time.perf_counter()
+        host = {
+            k: float(v) for k, v in zip(
+                stats.keys(), jax.device_get(list(stats.values()))
+            )
+        }
+        steps_d = step - self._last_sync_step
+        sps = tps = mfu = None
+        if self._last_sync_t is not None and steps_d > 0:
+            dt = max(now - self._last_sync_t, 1e-9)
+            sps = steps_d * self.global_batch / dt
+            self.g_sps.set(sps)
+            if self.tokens_per_sample:
+                tps = sps * self.tokens_per_sample
+                self.g_tps.set(tps)
+            if self.flops_per_step is not None and self._peak:
+                mfu = (steps_d * self.flops_per_step / dt) / self._peak
+                self.g_mfu.set(mfu)
+        self._last_sync_t = now
+        self._last_sync_step = step
+        skipped_d = skipped_total - self._last_skipped
+        self._last_skipped = skipped_total
+        self.g_loss.set(host["loss_raw"])
+        self.g_grad.set(host["grad_norm"])
+        self.g_param.set(host["param_norm"])
+        self.g_upd.set(host["update_norm"])
+        self.g_ratio.set(host["update_ratio"])
+        self.g_lr_scale.set(lr_scale)
+        if steps_d > 0:
+            self.c_steps.inc(steps_d)
+        if skipped_d > 0:
+            self.c_skipped.inc(skipped_d)
+        event = {
+            "step": int(step),
+            "epoch": int(epoch),
+            "model": self.model_name,
+            **{k: round(v, 6) for k, v in host.items()},
+            "skipped_total": int(skipped_total),
+            "skipped_delta": int(skipped_d),
+            "lr_scale": float(lr_scale),
+        }
+        if sps is not None:
+            event["samples_per_sec"] = round(sps, 1)
+        if tps is not None:
+            event["tokens_per_sec"] = round(tps, 1)
+        if mfu is not None:
+            event["mfu"] = round(mfu, 4)
+        self.log.info("train_step_telemetry", **event)
+        self.flight.record("train_step", **event)
+        if skipped_d > 0:
+            # Non-finite steps landed in the window ending at ``step``
+            # (exact step when the sync cadence is 1) — the record a
+            # flight dump needs to name the offending step.
+            self.flight.record(
+                "nonfinite_steps",
+                step=int(step),
+                window_start=int(step - steps_d + 1) if steps_d else int(step),
+                skipped_delta=int(skipped_d),
+                loss_raw=host["loss_raw"],
+                grad_norm=host["grad_norm"],
+            )
+        from ml_trainer_tpu.telemetry.export import default_sink
+
+        sink = default_sink()
+        if sink is not None:
+            sink.write(event, kind="train_step")
+        return host

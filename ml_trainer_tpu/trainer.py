@@ -194,6 +194,8 @@ class Trainer:
         rollback_lr_backoff: float = 0.5,
         save_every_steps: Optional[int] = None,
         handle_preemption: bool = True,
+        telemetry: bool = False,
+        log_every_steps: Optional[int] = None,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -307,6 +309,22 @@ class Trainer:
         mid-epoch bit-exactly (the resumed trajectory equals the
         uninterrupted one).  Requires ``steps_per_execution=1`` (the
         per-batch dispatch path owns the step cursor).
+
+        ``telemetry`` (default False): training step telemetry
+        (docs/observability.md) — grad-norm / param-norm / update-ratio
+        stats computed ON-DEVICE inside the compiled train step (pure
+        extra outputs: no host sync, no extra compiled programs, and the
+        update trajectory is untouched), fetched at the existing
+        ``log_every`` sync cadence and emitted as structured
+        ``train_step_telemetry`` events, registry gauges
+        (``telemetry.default_registry()``), and flight-recorder step
+        records — plus samples/s, tokens/s and an analytic MFU estimate
+        (``telemetry/flops.py``, TPU backend only).
+
+        ``log_every_steps``: override the host-sync cadence (default 50
+        steps) — the progress-bar fetch, rollback check, and telemetry
+        emission all ride this clock, so lowering it trades throughput
+        for observability granularity.
 
         ``handle_preemption`` (default True): ``fit()`` installs
         SIGTERM/SIGINT handlers (restored on exit) that finish the
@@ -450,8 +468,32 @@ class Trainer:
                 )
         self.save_every_steps = save_every_steps
         self.handle_preemption = bool(handle_preemption)
+        self.telemetry = bool(telemetry)
+        if log_every_steps is not None:
+            if log_every_steps < 1:
+                raise ValueError(
+                    f"log_every_steps must be >= 1, got {log_every_steps}"
+                )
+            self.log_every = int(log_every_steps)
+        from ml_trainer_tpu.telemetry.flight import get_recorder
+        from ml_trainer_tpu.telemetry.spans import (
+            PROFILE_ENV,
+            PROFILE_TRIGGER_ENV,
+            StepProfiler,
+        )
+
+        self._flight = get_recorder()
+        self._telemetry: Optional[Any] = None  # built with the loaders
+        self._profiler = StepProfiler("train")
+        # Per-step profiler polling only when something can trigger it.
+        self._profile_hook = bool(
+            self.telemetry
+            or os.environ.get(PROFILE_ENV)
+            or os.environ.get(PROFILE_TRIGGER_ENV)
+        )
         self.preempted = False
         self._preempt_requested = False
+        self.rollbacks = 0  # rollback-to-last-good events this run
         self.skipped_steps: list = []  # per-epoch skipped-step counts
         self._skipped_base = 0  # cumulative counter at current epoch start
         self._resume_mid: Optional[dict] = None  # mid-epoch resume cursor
@@ -817,6 +859,16 @@ class Trainer:
                     "Partitioned multi-host state: using per-host sharded "
                     "checkpoints (sharded_checkpoint=True)."
                 )
+        if self.telemetry:
+            from ml_trainer_tpu.telemetry.train_metrics import TrainTelemetry
+
+            self._telemetry = TrainTelemetry(
+                model=self.model,
+                model_name=type(self.model).__name__,
+                global_batch=self.global_batch,
+                batch_shape=(self.global_batch,) + tuple(sample_x.shape[1:]),
+                flight=self._flight,
+            )
         train_step = self._make_train_step()
         # Pin the output state to the SAME shardings it was born with: the
         # state's placement is a class invariant (resume/device_put, the
@@ -828,7 +880,10 @@ class Trainer:
         # deadlock against the v3 commit barrier.  Pinning restores ZeRO-1
         # semantics proper: the weight allgather happens INSIDE the
         # compiled step.
-        step_out_shardings = (self._state_shardings, None, None)
+        step_out_shardings = (
+            (self._state_shardings, None, None, None)
+            if self.telemetry else (self._state_shardings, None, None)
+        )
         self._train_step = jax.jit(
             train_step, donate_argnums=0, out_shardings=step_out_shardings
         )
@@ -838,10 +893,16 @@ class Trainer:
             # one host round-trip per K steps.
             def multi_step(state, xs, ys, lr_scale):
                 def body(state, xy):
-                    state, loss, metric_val = train_step(state, *xy, lr_scale)
-                    return state, (loss, metric_val)
+                    out = train_step(state, *xy, lr_scale)
+                    return out[0], out[1:]
 
-                state, (losses, metrics) = jax.lax.scan(body, state, (xs, ys))
+                state, outs = jax.lax.scan(body, state, (xs, ys))
+                losses, metrics = outs[0], outs[1]
+                if self.telemetry:
+                    # The dispatch's LAST step's stats — what the host
+                    # would have seen stepping per-batch at this cadence.
+                    last_stats = jax.tree.map(lambda s: s[-1], outs[2])
+                    return state, losses.sum(), metrics.sum(), last_stats
                 return state, losses.sum(), metrics.sum()
 
             self._train_multi_step = jax.jit(
@@ -868,6 +929,7 @@ class Trainer:
         accum = self.grad_accum_steps
         ema_decay = self.ema_decay
         guard = self.nonfinite_guard
+        telemetry = self.telemetry
 
         def grads_for(params, batch_stats, x, y, dropout_rng):
             def loss_fn(params):
@@ -958,6 +1020,7 @@ class Trainer:
                 if ema_decay is not None else state.ema_params
             )
             new_skipped, new_streak = state.skipped_steps, state.bad_streak
+            raw_loss = loss  # pre-guard: telemetry must SEE the NaN
             if guard:
                 # On-device all-finite guard: a non-finite loss or any
                 # non-finite gradient leaf reverts every learned quantity
@@ -1005,6 +1068,17 @@ class Trainer:
                 skipped_steps=new_skipped,
                 bad_streak=new_streak,
             )
+            if telemetry:
+                # On-device step stats (telemetry/train_metrics.py):
+                # pure functions of values this program already holds —
+                # same trajectory, same single compiled program, no
+                # host sync; the host fetches them at the log cadence.
+                from ml_trainer_tpu.telemetry.train_metrics import (
+                    step_stats,
+                )
+
+                stats = step_stats(raw_loss, grads, updates, new_params)
+                return new_state, loss, metric_val, stats
             return new_state, loss, metric_val
 
         return train_step
@@ -1088,7 +1162,9 @@ class Trainer:
         epoch_t0 = time.time()
         lr_scale = jnp.asarray(self._lr_scale, jnp.float32)
         if self.steps_per_execution > 1:
-            loss_sum, metric_sum = self._train_one_epoch_multi(n, lr_scale)
+            loss_sum, metric_sum = self._train_one_epoch_multi(
+                epoch, n, lr_scale
+            )
             if self._preempt_requested:
                 # Multi-step dispatch has no per-batch cursor: no
                 # emergency mid-epoch save — resume restarts from the
@@ -1126,22 +1202,26 @@ class Trainer:
             with tqdm(
                 batches, total=n, initial=start_b, unit="batch"
             ) as tepoch:
+                stats = None
                 for i, (x, y) in enumerate(tepoch):
                     done = start_b + i + 1  # 1-based batch cursor
+                    # 1-based global train step ((epoch-1)*steps_per_epoch
+                    # + batch) — pure host arithmetic, no device sync;
+                    # the fault-injection AND telemetry step coordinate.
+                    gstep = (epoch - 1) * n + done
                     if plan is not None:
-                        # Fault step coordinates are 1-based global train
-                        # steps ((epoch-1)*steps_per_epoch + batch) —
-                        # pure host arithmetic, no device sync.
-                        gstep = (epoch - 1) * n + done
                         if plan.fire("preempt", step=gstep) is not None:
                             self._request_preemption("injected preempt")
                         if plan.fire("nan_grad", step=gstep) is not None:
                             x = self._poison_batch(x)
-                    self.state, loss, metric_val = self._train_step(
-                        self.state, x, y, lr_scale
-                    )
+                    out = self._train_step(self.state, x, y, lr_scale)
+                    self.state, loss, metric_val = out[0], out[1], out[2]
+                    if self.telemetry:
+                        stats = out[3]
                     loss_sum = loss_sum + loss
                     metric_sum = metric_sum + metric_val
+                    if self._profile_hook:
+                        self._profiler.on_step(gstep)
                     if done % self.log_every == 0 or done == n:
                         # The only host syncs in the epoch (the reference
                         # pays one per batch, ref: src/trainer.py:186).
@@ -1156,7 +1236,13 @@ class Trainer:
                             )
                         else:
                             tepoch.set_postfix(loss=float(loss))
-                        if self._maybe_rollback():
+                        if self._telemetry is not None and stats is not None:
+                            self._telemetry.on_sync(
+                                gstep, stats, epoch=epoch,
+                                skipped_total=self._skipped_now(),
+                                lr_scale=self._lr_scale,
+                            )
+                        if self._maybe_rollback(gstep):
                             lr_scale = jnp.asarray(
                                 self._lr_scale, jnp.float32
                             )
@@ -1197,7 +1283,7 @@ class Trainer:
         if self.metric:
             self.train_metrics.append(self._metric_finalize(float(metric_sum) / n))
 
-    def _train_one_epoch_multi(self, n: int, lr_scale):
+    def _train_one_epoch_multi(self, epoch: int, n: int, lr_scale):
         """Epoch driven K optimizer steps per dispatch: full chunks of
         ``steps_per_execution`` batches go through the scanned program, the
         ragged tail through the per-batch step — same trajectory either
@@ -1214,7 +1300,7 @@ class Trainer:
         with tqdm(total=n, unit="batch") as tepoch:
             done = 0
 
-            def log(step_n, loss):
+            def log(step_n, loss, stats):
                 if done % max(self.log_every, k) < step_n or done == n:
                     if self.metric:
                         tepoch.set_postfix(
@@ -1225,29 +1311,37 @@ class Trainer:
                         # Mean loss of the last dispatch — the multi-step
                         # analog of the single-step path's last-batch loss.
                         tepoch.set_postfix(loss=float(loss) / step_n)
+                    if self._telemetry is not None and stats is not None:
+                        self._telemetry.on_sync(
+                            (epoch - 1) * n + done, stats, epoch=epoch,
+                            skipped_total=self._skipped_now(),
+                            lr_scale=self._lr_scale,
+                        )
 
             for xs, ys in stacked:
-                self.state, loss, metric_val = self._train_multi_step(
-                    self.state, xs, ys, lr_scale
-                )
+                out = self._train_multi_step(self.state, xs, ys, lr_scale)
+                self.state, loss, metric_val = out[0], out[1], out[2]
+                stats = out[3] if self.telemetry else None
                 loss_sum = loss_sum + loss
                 metric_sum = metric_sum + metric_val
                 done += k
+                if self._profile_hook:
+                    self._profiler.on_step((epoch - 1) * n + done)
                 tepoch.update(k)
-                log(k, loss)
+                log(k, loss, stats)
                 if self._preempt_requested:
                     return loss_sum, metric_sum
             for x, y in prefetch_to_device(
                 iter(tail), size=2, sharding=self._batch_sharding
             ):
-                self.state, loss, metric_val = self._train_step(
-                    self.state, x, y, lr_scale
-                )
+                out = self._train_step(self.state, x, y, lr_scale)
+                self.state, loss, metric_val = out[0], out[1], out[2]
+                stats = out[3] if self.telemetry else None
                 loss_sum = loss_sum + loss
                 metric_sum = metric_sum + metric_val
                 done += 1
                 tepoch.update(1)
-                log(1, loss)
+                log(1, loss, stats)
                 if self._preempt_requested:
                     return loss_sum, metric_sum
         return loss_sum, metric_sum
@@ -1331,6 +1425,14 @@ class Trainer:
         prev_handlers = self._install_preempt_handlers()
         try:
             self._fit(resume)
+        except Exception as e:
+            # Crash forensics: the last N step records + the error, on
+            # disk before the exception unwinds the process.
+            self._flight.dump(
+                "unhandled_exception", out_dir=self._flight_dir(),
+                error=f"{type(e).__name__}: {e}",
+            )
+            raise
         finally:
             self._restore_preempt_handlers(prev_handlers)
 
@@ -1385,6 +1487,13 @@ class Trainer:
             self._train_one_epoch(epoch)
             if self.preempted:
                 self._write_preempt_marker(ckpt_dir)
+                self._flight.record(
+                    "preemption", **(self._preempt_info or {"epoch": epoch})
+                )
+                self._flight.dump(
+                    "preemption", out_dir=self._flight_dir(),
+                    **(self._preempt_info or {"epoch": epoch}),
+                )
                 logger.warning(
                     "Preempted: emergency checkpoint committed; exiting "
                     "fit() cleanly (resume with fit(resume=True))."
@@ -1426,33 +1535,39 @@ class Trainer:
                 ckpt.fetch_to_host(variables)
                 if (is_primary() or export_is_collective) else None
             )
+            from ml_trainer_tpu.telemetry.spans import span
+
             if is_primary():
                 logger.info("Saving the model.")
                 from flax import serialization
 
                 # One device fetch + serialization covers both exports
                 # (the best/ copy is the same bytes on improving epochs).
-                data = serialization.to_bytes(host_vars)
-                ckpt.write_model_bytes(self.model_dir, data)
-                if improved and self.save_best:
-                    ckpt.write_model_bytes(
-                        os.path.join(self.model_dir, "best"), data
-                    )
+                with span("model_export", epoch=epoch):
+                    data = serialization.to_bytes(host_vars)
+                    ckpt.write_model_bytes(self.model_dir, data)
+                    if improved and self.save_best:
+                        ckpt.write_model_bytes(
+                            os.path.join(self.model_dir, "best"), data
+                        )
             if self._sharded_ckpt:
                 # COLLECTIVE: every process contributes its addressable
                 # shards; no host gathers the full state (format v3).
-                ckpt.save_checkpoint_sharded(
-                    ckpt_dir, self.state, self._partial_history(), epoch,
-                    block=False,
-                )
+                with span("ckpt_write", epoch=epoch, sharded=True):
+                    ckpt.save_checkpoint_sharded(
+                        ckpt_dir, self.state, self._partial_history(), epoch,
+                        block=False,
+                    )
             elif is_primary():
                 # Async: the write lands on the background writer thread
                 # while the next epoch trains (jax arrays are immutable, so
                 # the snapshot is consistent); fit-end joins the queue.
-                ckpt.save_checkpoint(
-                    ckpt_dir, self.state, self._partial_history(), epoch,
-                    block=False,
-                )
+                # The span covers the enqueue (the host-blocking part).
+                with span("ckpt_write", epoch=epoch, sharded=False):
+                    ckpt.save_checkpoint(
+                        ckpt_dir, self.state, self._partial_history(), epoch,
+                        block=False,
+                    )
             if self.metric:
                 logger.info(
                     f"train loss: {self.train_losses[-1]} - "
@@ -1475,8 +1590,10 @@ class Trainer:
             "val_metric": self.val_metrics,
             "metric_type": self.metric,
             # Per-epoch count of steps the on-device all-finite guard
-            # skipped (all zeros on a healthy run).
+            # skipped (all zeros on a healthy run), and the number of
+            # rollback-to-last-good events — the resilience ledger.
             "skipped_steps": self.skipped_steps,
+            "rollbacks": self.rollbacks,
         }
         if self.save_history and is_primary():
             self.save_history_(self.model_dir)
@@ -1504,6 +1621,7 @@ class Trainer:
             "metric_type": self.metric,
             "lr_scale": self._lr_scale,
             "skipped_steps": self.skipped_steps,
+            "rollbacks": self.rollbacks,
         }
         if self._plateau is not None:
             h["plateau"] = {
@@ -1528,6 +1646,7 @@ class Trainer:
         self.train_metrics = list(saved.get("train_metric", []))
         self.val_metrics = list(saved.get("val_metric", []))
         self.skipped_steps = list(saved.get("skipped_steps", []))
+        self.rollbacks = int(saved.get("rollbacks", 0))
         self._lr_scale = float(saved.get("lr_scale", 1.0))
         plateau = saved.get("plateau", {})
         if self._plateau is not None:
@@ -1567,19 +1686,38 @@ class Trainer:
             "metric_sum": float(metric_sum),
             "skipped_base": int(self._skipped_base),
         }
+        from ml_trainer_tpu.telemetry.spans import span
+
         ckpt_dir = os.path.join(self.model_dir, "checkpoints")
         if self._sharded_ckpt:
-            ckpt.save_checkpoint_sharded(
-                ckpt_dir, self.state, hist, epoch, block=False
-            )
+            with span("ckpt_write", epoch=epoch, batch=batches_done,
+                      sharded=True):
+                ckpt.save_checkpoint_sharded(
+                    ckpt_dir, self.state, hist, epoch, block=False
+                )
         elif is_primary():
             # Async: the writer thread serializes this with epoch-end
             # saves (single-queue FIFO), so same-epoch writes never race.
-            ckpt.save_checkpoint(
-                ckpt_dir, self.state, hist, epoch, block=False
-            )
+            with span("ckpt_write", epoch=epoch, batch=batches_done,
+                      sharded=False):
+                ckpt.save_checkpoint(
+                    ckpt_dir, self.state, hist, epoch, block=False
+                )
 
-    def _maybe_rollback(self) -> bool:
+    def _skipped_now(self) -> int:
+        """Cumulative on-device skipped-step count (one scalar fetch)."""
+        if self.state is None or self.state.skipped_steps is None:
+            return 0
+        return int(jax.device_get(self.state.skipped_steps))
+
+    def _flight_dir(self) -> str:
+        """Flight dumps land next to the checkpoints unless the env var
+        redirects them (telemetry/flight.py resolution order)."""
+        from ml_trainer_tpu.telemetry.flight import FLIGHT_DIR_ENV
+
+        return os.environ.get(FLIGHT_DIR_ENV) or self.model_dir
+
+    def _maybe_rollback(self, gstep: int = 0) -> bool:
         """Rollback-to-last-good: when ``rollback_bad_steps`` CONSECUTIVE
         steps were skipped as non-finite, restore the newest checkpoint
         that verifies (corrupt ones quarantined) and back the LR off by
@@ -1592,6 +1730,22 @@ class Trainer:
         if streak < self.rollback_bad_steps:
             return False
         self._lr_scale *= self.rollback_lr_backoff
+        self.rollbacks += 1
+        # Crash forensics BEFORE the restore mutates the state: the ring
+        # holds the step records leading in, and the rollback event names
+        # the bad streak's boundaries (exact when log_every == 1).
+        self._flight.record(
+            "rollback", step=int(gstep), streak=streak,
+            first_bad_step=int(gstep) - streak + 1,
+            lr_scale=self._lr_scale,
+        )
+        if self._telemetry is not None:
+            self._telemetry.c_rollbacks.inc()
+        self._flight.dump(
+            "nan_rollback", out_dir=self._flight_dir(),
+            step=int(gstep), first_bad_step=int(gstep) - streak + 1,
+            streak=streak,
+        )
         zero = jax.device_put(jnp.zeros((), jnp.int32), self._replicated)
         ckpt_dir = os.path.join(self.model_dir, "checkpoints")
         ckpt.wait_for_checkpoints()  # in-flight async writes must land
@@ -1800,6 +1954,7 @@ class Trainer:
         self.train_metrics = list(saved.get("train_metric", []))
         self.val_metrics = list(saved.get("val_metric", []))
         self.skipped_steps = list(saved.get("skipped_steps", []))
+        self.rollbacks = int(saved.get("rollbacks", 0))
         done_epoch = int(scalars[0])
         self._lr_scale = float(scalars[1])
         if self._plateau is not None:
@@ -2021,13 +2176,22 @@ class Trainer:
 
     def save_history_(self, model_dir: str) -> None:
         """Pickle the history dict (ref: src/trainer.py:237-241) — same
-        ``history.pkl`` name so ``load_history`` round-trips."""
+        ``history.pkl`` name so ``load_history`` round-trips — plus a
+        ``history.json`` mirror (JSON-safe scalars, including the
+        skipped_steps / rollbacks resilience ledger) so offline tooling
+        reads a run without unpickling; ``load_history`` prefers it."""
         logger.info("Saving the training history.")
+        import json
         import pickle
 
         os.makedirs(model_dir, exist_ok=True)
         with open(os.path.join(model_dir, "history.pkl"), "wb") as fp:
             pickle.dump(self.history, fp)
+        tmp = os.path.join(model_dir, "history.json.tmp")
+        with open(tmp, "w", encoding="utf-8") as fp:
+            # numpy scalars riding in the lists coerce through float().
+            json.dump(self.history, fp, default=float, indent=1)
+        os.replace(tmp, os.path.join(model_dir, "history.json"))
 
     def clear(self) -> None:
         """GC pass (ref: src/trainer.py:303-305).  XLA's arena allocator has

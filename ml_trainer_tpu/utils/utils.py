@@ -61,7 +61,16 @@ class LRUCache:
 
 
 def load_history(file_dir: str) -> dict:
-    """Unpickle ``history.pkl`` from a directory (ref: src/utils/utils.py:9-12)."""
+    """Training history from a directory.  Prefers the ``history.json``
+    mirror the Trainer writes next to the pickle (no unpickling, safe
+    for offline tooling); falls back to ``history.pkl``
+    (ref: src/utils/utils.py:9-12)."""
+    json_path = os.path.join(file_dir, "history.json")
+    if os.path.exists(json_path):
+        import json
+
+        with open(json_path, encoding="utf-8") as fp:
+            return json.load(fp)
     path = os.path.join(file_dir, "history.pkl")
     with open(path, "rb") as fp:
         return pickle.load(fp)

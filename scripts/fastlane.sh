@@ -3,7 +3,9 @@
 # committed wrapper so the builder and the reviewer run the identical
 # command (pipefail, CPU pinned, fast lane only, DOTS_PASSED count) —
 # plus a fault-injection smoke leg (scripts/chaos_smoke.py) covering the
-# resilience layer's env-var plumbing end to end.
+# resilience layer's env-var plumbing end to end, and a telemetry smoke
+# leg (scripts/telemetry_smoke.py) covering the observability spine
+# (registry gauges, Prometheus exposition, spans, flight dumps).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -20,6 +22,11 @@ echo "# fault-injection smoke leg"
 timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
 smoke_rc=$?
 [ $smoke_rc -ne 0 ] && echo "# chaos smoke FAILED (rc=$smoke_rc)"
+echo "# telemetry smoke leg"
+timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
+telemetry_rc=$?
+[ $telemetry_rc -ne 0 ] && echo "# telemetry smoke FAILED (rc=$telemetry_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 [ $rc -eq 0 ] && rc=$smoke_rc
+[ $rc -eq 0 ] && rc=$telemetry_rc
 exit $rc

@@ -29,33 +29,22 @@ import numpy as np
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, ROOT)
 
-# Published per-chip HBM bandwidth by generation; the FLOPs peak comes
-# from bench.py's _chip_peak_flops so the ledger's MFU denominator can
-# never disagree with the BASELINE rows by hardware generation.
-HBM_PEAKS = {
-    "v6e": 1640e9, "v6": 1640e9,
-    "v5p": 2765e9,
-    "v5e": 819e9, "v5 lite": 819e9, "v5lite": 819e9,
-    "v4": 1228e9,
-}
-
-
 def chip_peaks():
     """(peak FLOP/s, peak HBM B/s, matched-generation label).
 
-    The label is recorded in the ledger so an unrecognized device kind —
-    which falls back to the v5e bandwidth and can skew the mxu-vs-hbm
-    'bound' verdict — is visible in the artifact instead of silent."""
-    from bench import _chip_peak_flops
-
-    kind = getattr(jax.devices()[0], "device_kind", "").lower()
-    gen = os.environ.get("PALLAS_AXON_TPU_GEN", "").lower()
-    matched = next(
-        (k for k in HBM_PEAKS if k in gen or k in kind), None
+    Both peak tables live in the telemetry spine (telemetry/flops.py) —
+    one owner, so the ledger, bench.py, and the trainer's live MFU line
+    can never disagree by hardware generation.  The label is recorded in
+    the ledger so an unrecognized device kind — which falls back to the
+    v5e numbers and can skew the mxu-vs-hbm 'bound' verdict — is visible
+    in the artifact instead of silent."""
+    from ml_trainer_tpu.telemetry.flops import (
+        chip_generation_label,
+        chip_peak_flops,
+        chip_peak_hbm_bytes,
     )
-    bw = HBM_PEAKS[matched] if matched else 819e9
-    label = matched or f"unknown-default-v5e (kind={kind!r}, gen={gen!r})"
-    return _chip_peak_flops(), bw, label
+
+    return chip_peak_flops(), chip_peak_hbm_bytes(), chip_generation_label()
 
 
 def measure(model_name: str, batch: int) -> dict:
@@ -129,6 +118,12 @@ def measure(model_name: str, batch: int) -> dict:
     peak_flops, peak_bw, hbm_generation = chip_peaks()
     achieved_flops = flops / dt if flops else None
     achieved_bw = bytes_accessed / dt if bytes_accessed else None
+    # Analytic cross-check (telemetry/flops.py): when the measured XLA
+    # number and the formula disagree wildly, one of them is lying about
+    # the workload — worth seeing in the artifact.
+    from ml_trainer_tpu.telemetry.flops import train_step_flops
+
+    analytic = train_step_flops(model, (batch, 224, 224, 3))
     row = {
         "model": model_name,
         "batch": batch,
@@ -136,6 +131,7 @@ def measure(model_name: str, batch: int) -> dict:
         "step_ms": round(dt * 1e3, 3),
         "samples_per_sec": round(batch / dt, 1),
         "flops_per_step": flops,
+        "flops_per_step_analytic": analytic,
         "bytes_per_step": bytes_accessed,
         "arith_intensity_flops_per_byte": (
             round(flops / bytes_accessed, 1) if bytes_accessed else None

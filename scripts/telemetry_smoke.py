@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Telemetry smoke leg (scripts/fastlane.sh) — ~30s on CPU.
+
+One tiny end-to-end pass over the telemetry spine's cheap guarantees,
+as a standalone script so the fast lane exercises the REAL env-var
+plumbing (flight-dir redirect, JSONL sink), not just the programmatic
+test hooks:
+
+1. A one-epoch ``Trainer(telemetry=True)`` run with an injected
+   ``nan_grad`` + rollback emits train gauges into the default
+   registry, writes a ``history.json`` mirror ``load_history`` prefers,
+   and dumps a flight record naming the offending step.
+2. The registry round-trips through Prometheus text exposition
+   (headers + samples parse) and the JSONL sink appends parseable
+   lines.
+3. The span buffer holds the run's ``data_load`` / ``h2d`` /
+   ``ckpt_write`` spans and saves a loadable Perfetto trace.
+
+Exits non-zero (with a reason) on any violation.
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    workdir = tempfile.mkdtemp(prefix="telemetry_smoke_")
+    os.environ["ML_TRAINER_TPU_FLIGHT_DIR"] = workdir
+    os.environ["ML_TRAINER_TPU_METRICS_JSONL"] = os.path.join(
+        workdir, "metrics.jsonl"
+    )
+
+    from ml_trainer_tpu import Trainer, MLModel, load_history
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+    from ml_trainer_tpu.resilience import faults
+    from ml_trainer_tpu.telemetry import (
+        default_registry,
+        prometheus_text,
+        save_trace,
+        trace_events,
+    )
+    from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+    def fail(msg):
+        print(f"TELEMETRY_SMOKE FAIL: {msg}")
+        return 1
+
+    t0 = custom_pre_process_function()
+    with faults.injected("nan_grad@step=3"):
+        t = Trainer(
+            MLModel(),
+            datasets=(SyntheticCIFAR10(size=64, seed=0, transform=t0),
+                      SyntheticCIFAR10(size=32, seed=1, transform=t0)),
+            epochs=1, batch_size=16, model_dir=workdir, metric=None,
+            lr=0.01, save_history=True, telemetry=True, log_every_steps=1,
+            rollback_bad_steps=1,
+        )
+        t.fit()
+
+    # 1. Registry gauges + flight dump + history.json.
+    snap = default_registry().snapshot()
+    if snap.get("train_skipped_steps_total", 0) < 1:
+        return fail(f"skipped-step counter not published: {snap}")
+    if t._train_step._cache_size() != 1:
+        return fail(
+            f"telemetry caused recompiles: {t._train_step._cache_size()}"
+        )
+    dumps = [f for f in os.listdir(workdir) if f.startswith("flight_")]
+    if not dumps:
+        return fail("no flight dump after nan_grad rollback")
+    payload = json.load(open(os.path.join(workdir, dumps[0])))
+    if payload.get("first_bad_step") != 3:
+        return fail(f"flight dump does not name step 3: {payload.get('first_bad_step')}")
+    hist = load_history(workdir)
+    if hist.get("rollbacks") != 1 or sum(hist.get("skipped_steps", [])) != 1:
+        return fail(f"history.json resilience ledger wrong: {hist}")
+
+    # 2. Prometheus text + JSONL sink.
+    text = prometheus_text(default_registry())
+    if "# TYPE train_grad_norm gauge" not in text:
+        return fail("prometheus exposition missing train gauges")
+    for line in text.splitlines():
+        if not (line.startswith("#") or " " in line):
+            return fail(f"malformed exposition line: {line!r}")
+    with open(os.environ["ML_TRAINER_TPU_METRICS_JSONL"]) as fp:
+        lines = [json.loads(ln) for ln in fp if ln.strip()]
+    if not any(ln.get("kind") == "train_step" for ln in lines):
+        return fail("JSONL sink holds no train_step events")
+
+    # 3. Spans: the run's host regions are on the trace.
+    names = {e["name"] for e in trace_events()}
+    for expected in ("data_load", "h2d", "ckpt_write"):
+        if expected not in names:
+            return fail(f"span {expected!r} missing from trace ({names})")
+    trace_path = save_trace(os.path.join(workdir, "trace.json"))
+    loaded = json.load(open(trace_path))
+    if not loaded.get("traceEvents"):
+        return fail("saved Perfetto trace is empty")
+
+    print(
+        "TELEMETRY_SMOKE OK: "
+        f"{int(snap['train_steps_total'])} steps telemetered, "
+        f"flight dump {dumps[0]} names step 3, "
+        f"{len(loaded['traceEvents'])} trace events, "
+        f"{len(lines)} JSONL records"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -84,11 +84,14 @@ def test_golden_trajectory_reproduces(golden, tmp_path):
     trainer.fit()
 
     h, g = trainer.history, golden["history"]
-    # skipped_steps (the nonfinite-guard counter, added after the golden
+    # The resilience ledger (skipped_steps from the nonfinite guard,
+    # rollbacks from rollback-to-last-good — both added after the golden
     # record was captured) is compared only when the record carries it;
     # a healthy run's counts are all zero either way.
-    assert set(h) - {"skipped_steps"} == set(g) - {"skipped_steps"}
+    ledger = {"skipped_steps", "rollbacks"}
+    assert set(h) - ledger == set(g) - ledger
     assert h["skipped_steps"] == [0] * len(h["epochs"])
+    assert h["rollbacks"] == 0
     assert h["epochs"] == g["epochs"]
     # Full per-epoch trajectory, not just the endpoint.
     for k, tol in (("train_loss", 0.2), ("val_loss", 0.2),

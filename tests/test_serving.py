@@ -207,13 +207,24 @@ def test_http_front_end_round_trip(model_and_vars):
         )
         with urllib.request.urlopen(req, timeout=120) as resp:
             out = json.loads(resp.read())
-        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+        with urllib.request.urlopen(
+            f"{base}/metrics.json", timeout=30
+        ) as resp:
             snap = json.loads(resp.read())
+        with urllib.request.urlopen(f"{base}/metrics", timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            prom = resp.read().decode()
         with urllib.request.urlopen(f"{base}/healthz", timeout=30) as resp:
             health = json.loads(resp.read())
             assert health["ok"] is True and health["healthy"] is True
     np.testing.assert_array_equal(np.asarray(out["tokens"], np.int32), ref)
     assert snap["requests_completed"] >= 1
+    # /metrics is Prometheus text exposition now (the telemetry spine);
+    # the resilience counters must be scrapeable.
+    assert "# TYPE serving_requests_completed gauge" in prom
+    assert "serving_watchdog_trips 0" in prom
+    for line in prom.splitlines():
+        assert line.startswith("#") or " " in line, line
 
 
 def test_close_fails_inflight_requests_instead_of_hanging(model_and_vars):
@@ -243,3 +254,67 @@ def test_lru_bounds_compiled_programs():
     assert lru.get(2) == 20
     lru[5] = 50
     assert lru.get(3) is None and lru.get(2) == 20
+
+
+def test_metrics_snapshot_hammer_under_concurrent_recording():
+    """The crash-fix hunt for ServingMetrics.snapshot(): every record_*
+    path hammered from threads while snapshot()/log()/publish() scrape
+    concurrently.  Pins the concurrency contract — no ZeroDivisionError
+    on empty windows (fresh instance, spec hist empty, zero busy time),
+    no mutated-during-iteration crashes, and values stay finite."""
+    import threading
+
+    from ml_trainer_tpu.serving.metrics import ServingMetrics
+    from ml_trainer_tpu.telemetry.registry import MetricsRegistry
+
+    m = ServingMetrics(window=8)  # tiny window: rollover under fire
+    stop = threading.Event()
+    errors = []
+
+    def recorder(seed):
+        rng = np.random.default_rng(seed)
+        try:
+            while not stop.is_set():
+                m.record_ttft(float(rng.random()))
+                m.record_prefill(float(rng.random()) * 1e-3)
+                m.record_step(float(rng.random()) * 1e-3,
+                              int(rng.integers(0, 5)), 4, 1)
+                m.record_admission(int(rng.integers(0, 9)))
+                m.record_completion()
+                m.record_spec([int(a) for a in rng.integers(0, 4, 3)], 3)
+                m.record_queue_depth(int(rng.integers(0, 9)))
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    def scraper():
+        reg = MetricsRegistry()
+        try:
+            while not stop.is_set():
+                snap = m.snapshot()
+                assert snap["slot_occupancy_mean"] <= 1.0
+                m.publish(reg)
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    # An EMPTY metrics object must snapshot cleanly too (every divisor
+    # has a zero-denominator guard).
+    empty = ServingMetrics().snapshot()
+    assert empty["tokens_per_sec_busy"] == 0.0
+    assert empty["spec_acceptance_rate"] == 0.0
+    assert empty["spec_tokens_per_step"] == 0.0
+    with pytest.raises(ValueError, match="window"):
+        ServingMetrics(window=0)
+
+    threads = [threading.Thread(target=recorder, args=(i,))
+               for i in range(3)]
+    threads += [threading.Thread(target=scraper) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    final = m.snapshot()
+    assert final["requests_completed"] > 0
+    assert final["spec_acceptance_rate"] <= 1.0

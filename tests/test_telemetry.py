@@ -1,0 +1,333 @@
+"""Telemetry spine (ml_trainer_tpu/telemetry/).
+
+The contracts worth pinning:
+
+* registry: thread-safe under concurrent writers, idempotent
+  registration, Prometheus text exposition matches a golden string;
+* spans: Chrome/Perfetto trace-event JSON loads, and same-thread spans
+  nest by time containment (how Perfetto renders parent/child);
+* flight recorder: bounded ring; an injected ``nan_grad`` FaultPlan
+  with rollback produces a dump naming the offending step; an injected
+  ``decode_wedge`` produces a serving dump naming the wedged engine
+  step;
+* step telemetry: ZERO extra compiled programs — the instrumented
+  trainer's step compiles exactly once, like the bare trainer's
+  (test-pinned cache size), and the trajectory is bit-identical;
+* StepTimer: per-step percentiles (fenced, warmup-excluded);
+* history.json: JSON-safe mirror written next to the pickle,
+  preferred by ``load_history``.
+"""
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from ml_trainer_tpu import Trainer, MLModel, load_history
+from ml_trainer_tpu.data import SyntheticCIFAR10
+from ml_trainer_tpu.resilience import faults
+from ml_trainer_tpu.telemetry import (
+    FlightRecorder,
+    MetricsRegistry,
+    prometheus_text,
+    save_trace,
+    span,
+)
+from ml_trainer_tpu.telemetry.flight import get_recorder
+from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+
+def make_trainer(model_dir, epochs=1, size=64, **kw):
+    t = custom_pre_process_function()  # float batches: NaN-poisonable
+    return Trainer(
+        MLModel(),
+        datasets=(SyntheticCIFAR10(size=size, seed=0, transform=t),
+                  SyntheticCIFAR10(size=32, seed=1, transform=t)),
+        epochs=epochs, batch_size=16, model_dir=str(model_dir),
+        metric=None, lr=0.01, **kw,
+    )
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_thread_safety():
+    """N writer threads hammering one counter/gauge/histogram: the
+    counter lands on the exact total (a lost update would undercount),
+    the histogram's count matches its observations."""
+    r = MetricsRegistry()
+    c = r.counter("hits_total", "hits", ("worker",))
+    g = r.gauge("level")
+    h = r.histogram("lat", buckets=(0.5, 1.0))
+    n_threads, n_iter = 8, 2000
+
+    def worker(i):
+        child = c.labels(worker=str(i % 2))
+        for k in range(n_iter):
+            child.inc()
+            g.set(k)
+            h.observe(0.25 if k % 2 else 0.75)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = sum(
+        c.labels(worker=str(w)).get() for w in (0, 1)
+    )
+    assert total == n_threads * n_iter
+    assert h.get() is None or True  # labeled access below
+    hist = h._get(())
+    assert hist["count"] == n_threads * n_iter
+
+
+def test_registry_idempotent_and_type_checked():
+    r = MetricsRegistry()
+    a = r.counter("x_total", "first")
+    b = r.counter("x_total", "second registration returns the first")
+    assert a is b
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("x_total")
+    with pytest.raises(ValueError, match="metric name"):
+        r.counter("bad name")
+
+
+def test_prometheus_exposition_golden():
+    """Pinned text exposition: a scraper-visible format change must be a
+    deliberate diff in this golden, not an accident."""
+    r = MetricsRegistry()
+    c = r.counter("requests_total", "served requests", ("code",))
+    c.labels(code=200).inc(3)
+    c.labels(code=500).inc()
+    r.gauge("queue_depth", "pending requests").set(7)
+    h = r.histogram("step_seconds", "step latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    golden = (
+        "# HELP requests_total served requests\n"
+        "# TYPE requests_total counter\n"
+        'requests_total{code="200"} 3\n'
+        'requests_total{code="500"} 1\n'
+        "# HELP queue_depth pending requests\n"
+        "# TYPE queue_depth gauge\n"
+        "queue_depth 7\n"
+        "# HELP step_seconds step latency\n"
+        "# TYPE step_seconds histogram\n"
+        'step_seconds_bucket{le="0.1"} 1\n'
+        'step_seconds_bucket{le="1"} 2\n'
+        'step_seconds_bucket{le="+Inf"} 3\n'
+        "step_seconds_sum 5.55\n"
+        "step_seconds_count 3\n"
+    )
+    assert prometheus_text(r) == golden
+
+
+# ------------------------------------------------------------------- spans
+def test_perfetto_trace_loads_and_nests(tmp_path):
+    from ml_trainer_tpu.telemetry.spans import clear_trace
+
+    clear_trace()
+    with span("outer", step=1):
+        with span("inner"):
+            pass
+    path = save_trace(str(tmp_path / "trace.json"))
+    events = json.load(open(path))["traceEvents"]
+    by_name = {e["name"]: e for e in events}
+    outer, inner = by_name["outer"], by_name["inner"]
+    for e in (outer, inner):
+        assert e["ph"] == "X" and e["dur"] >= 0
+    # Same thread, inner contained in outer: how Perfetto nests.
+    assert inner["tid"] == outer["tid"]
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-3
+    assert outer["args"] == {"step": 1}
+
+
+# --------------------------------------------------------- flight recorder
+def test_flight_ring_bounded_and_dump(tmp_path):
+    fr = FlightRecorder(capacity=4)
+    for i in range(10):
+        fr.record("step", step=i)
+    recs = fr.records()
+    assert [r["step"] for r in recs] == [6, 7, 8, 9]
+    path = fr.dump("unit_test", out_dir=str(tmp_path), extra_field=1)
+    payload = json.load(open(path))
+    assert payload["reason"] == "unit_test"
+    assert payload["extra_field"] == 1
+    assert len(payload["records"]) == 4
+
+
+def test_nan_grad_fault_dumps_flight_naming_step(tmp_path, monkeypatch):
+    """The acceptance scenario: an injected ``nan_grad`` (with rollback
+    armed) must leave a flight dump on disk naming the offending step."""
+    monkeypatch.setenv("ML_TRAINER_TPU_FLIGHT_DIR", str(tmp_path))
+    get_recorder().clear()
+    with faults.injected("nan_grad@step=3"):
+        t = make_trainer(
+            tmp_path / "m", telemetry=True, log_every_steps=1,
+            rollback_bad_steps=1,
+        )
+        t.fit()
+    assert t.rollbacks == 1
+    dumps = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("flight_")
+    )
+    assert dumps, "nan_grad rollback produced no flight dump"
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert payload["reason"] == "nan_rollback"
+    assert payload["first_bad_step"] == 3
+    kinds = [r["kind"] for r in payload["records"]]
+    assert "nonfinite_steps" in kinds and "rollback" in kinds
+    nf = next(r for r in payload["records"] if r["kind"] == "nonfinite_steps")
+    assert nf["step"] == 3
+
+
+def test_decode_wedge_fault_dumps_flight_naming_engine_step(
+    tmp_path, monkeypatch
+):
+    """A wedged decode step trips the watchdog, which dumps the flight
+    ring — its newest decode_step record names the wedged step."""
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import EngineUnhealthy, Server
+
+    monkeypatch.setenv("ML_TRAINER_TPU_FLIGHT_DIR", str(tmp_path))
+    get_recorder().clear()
+    model = get_model("gpt2_tiny", max_len=64)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    # Warm the compiled programs through a throwaway server so the
+    # watchdog timeout only has to cover the wedge, not a compile.
+    with Server(model, variables, max_batch=2,
+                watchdog_timeout=None) as warm:
+        warm.complete(np.arange(1, 6, dtype=np.int32), 4, timeout=300)
+    with faults.injected("decode_wedge@step=2,secs=30") as plan:
+        server = Server(model, variables, max_batch=2,
+                        watchdog_timeout=1.0)
+        try:
+            stream = server.submit(np.arange(1, 6, dtype=np.int32), 16)
+            with pytest.raises((RuntimeError, EngineUnhealthy)):
+                stream.result(timeout=60)
+            assert not server.healthy
+        finally:
+            plan.release_wedge()
+            server.close()
+    dumps = sorted(
+        f for f in os.listdir(tmp_path) if f.startswith("flight_")
+    )
+    assert dumps, "watchdog trip produced no flight dump"
+    payload = json.load(open(tmp_path / dumps[-1]))
+    assert payload["reason"].startswith("serving_unhealthy")
+    assert payload["engine_step"] == 2
+    steps = [r for r in payload["records"] if r["kind"] == "decode_step"]
+    assert steps and steps[-1]["engine_step"] == 2
+
+
+# ---------------------------------------------------- step telemetry cost
+def test_step_telemetry_zero_recompiles_and_identical_trajectory(tmp_path):
+    """The acceptance pin: the instrumented train step compiles exactly
+    as many programs as the bare one (one), across a full multi-epoch
+    fit — and produces the bit-identical parameter trajectory."""
+    bare = make_trainer(tmp_path / "bare", epochs=2)
+    bare.fit()
+    instr = make_trainer(tmp_path / "instr", epochs=2, telemetry=True)
+    instr.fit()
+    assert bare._train_step._cache_size() == 1
+    assert instr._train_step._cache_size() == 1
+    for a, b in zip(
+        jax.tree.leaves(bare.state.params),
+        jax.tree.leaves(instr.state.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The telemetry actually ran: gauges were published.
+    from ml_trainer_tpu.telemetry import default_registry
+
+    snap = default_registry().snapshot()
+    assert snap.get("train_steps_total", 0) >= instr.steps_per_epoch
+
+
+def test_multi_step_dispatch_carries_stats(tmp_path):
+    """steps_per_execution > 1: the scanned dispatch returns the last
+    step's stats and telemetry still compiles one multi-step program."""
+    t = make_trainer(
+        tmp_path / "multi", size=128, telemetry=True,
+        steps_per_execution=4,
+    )
+    t.fit()
+    assert t._train_multi_step._cache_size() == 1
+    from ml_trainer_tpu.telemetry import default_registry
+
+    assert default_registry().snapshot()["train_param_norm"] > 0
+
+
+# ------------------------------------------------------------- StepTimer
+def test_steptimer_percentiles():
+    import time as _time
+
+    from ml_trainer_tpu.utils.profiler import StepTimer
+
+    timer = StepTimer(warmup=2, record_steps=True)
+    delays = [0.001, 0.001, 0.005, 0.001, 0.02, 0.001, 0.001, 0.001]
+    for d in delays:
+        _time.sleep(d)
+        timer.tick(np.zeros(1), 1)
+    p50, p99 = timer.p50(), timer.p99()
+    assert p50 is not None and p99 is not None
+    assert p99 >= p50
+    assert p99 >= 0.015  # the 20ms outlier is in the tail
+    assert timer.rate() > 0
+    # Default mode records nothing: p50 stays None.
+    assert StepTimer(warmup=1).p50() is None
+
+
+# ---------------------------------------------------------- history.json
+def test_history_json_mirror_and_preference(tmp_path):
+    t = make_trainer(tmp_path / "h", save_history=True)
+    t.fit()
+    d = str(tmp_path / "h")
+    assert os.path.exists(os.path.join(d, "history.pkl"))
+    jpath = os.path.join(d, "history.json")
+    assert os.path.exists(jpath)
+    hist = json.load(open(jpath))
+    assert hist["train_loss"] and "skipped_steps" in hist
+    assert hist["rollbacks"] == 0
+    # load_history prefers the JSON mirror: poison it with a marker and
+    # check the marker comes back (the pickle would not carry it).
+    hist["marker"] = "json_wins"
+    json.dump(hist, open(jpath, "w"))
+    assert load_history(d)["marker"] == "json_wins"
+    # Without the mirror, the pickle still loads (the reference path).
+    os.remove(jpath)
+    assert load_history(d)["train_loss"] == hist["train_loss"]
+
+
+# ------------------------------------------------------------------ flops
+def test_analytic_flops_plausible():
+    """The analytic accounting must agree with the known published
+    numbers within tolerance: ResNet-50 fwd ~8.2 GFLOPs/img @224 (2*MAC
+    convention), ViT-B/16 ~35, GPT-2-124M train ~6N per token."""
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.telemetry.flops import (
+        fwd_flops,
+        train_step_flops,
+    )
+
+    r50 = fwd_flops(get_model("resnet50"), (1, 224, 224, 3))
+    assert 7e9 < r50 < 9.5e9
+    vit = fwd_flops(get_model("vit_b16"), (1, 224, 224, 3))
+    assert 30e9 < vit < 40e9
+    gpt2 = train_step_flops(get_model("gpt2"), (1, 1024))
+    # 6 * ~163M matmul params (incl. the tied head) * 1024 tokens, plus
+    # attention: the right order of magnitude band.
+    assert 700e9 < gpt2 < 1200e9
+    assert train_step_flops("mlmodel", (32, 32, 32, 3)) > 0
+    # Unknown family: None, never zero.
+    class Oddball:  # noqa: local stub, not a registered model
+        pass
+
+    assert train_step_flops(Oddball(), (1, 8)) is None
