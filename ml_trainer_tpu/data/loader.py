@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import collections
 import itertools
+import threading
+import time
 from typing import Iterator, Optional, Tuple
 
 import jax
@@ -103,6 +105,29 @@ class Loader:
             yield x, y
 
 
+# Data-loader lag accounting: host seconds spent blocked in the underlying
+# iterator (the ``data_load`` span), per batch.  The per-host heartbeat
+# (telemetry/cluster.py) reads this — a pod host whose loader-wait climbs
+# while its step time holds is input-bound, not compute-straggling.
+_wait_lock = threading.Lock()
+_wait_secs = 0.0
+_wait_batches = 0
+
+
+def loader_wait_snapshot() -> Tuple[float, int]:
+    """Cumulative (seconds-blocked, batches-loaded) of every
+    ``prefetch_to_device`` iterator in this process."""
+    with _wait_lock:
+        return _wait_secs, _wait_batches
+
+
+def _record_wait(secs: float) -> None:
+    global _wait_secs, _wait_batches
+    with _wait_lock:
+        _wait_secs += secs
+        _wait_batches += 1
+
+
 def prefetch_to_device(
     iterator,
     size: int = 2,
@@ -153,8 +178,11 @@ def prefetch_to_device(
     it = iter(iterator)
 
     def load_next():
+        t0 = time.perf_counter()
         with span("data_load"):
-            return next(it, None)
+            batch = next(it, None)
+        _record_wait(time.perf_counter() - t0)
+        return batch
 
     def put_spanned(batch):
         with span("h2d"):

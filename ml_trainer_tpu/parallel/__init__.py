@@ -26,6 +26,7 @@ from ml_trainer_tpu.parallel.distributed import (
 from ml_trainer_tpu.parallel.sharding import (
     batch_sharding,
     fit_sharding_to_rank,
+    place_tree,
     replicated,
     shard_opt_state,
     shard_params,
@@ -62,6 +63,7 @@ __all__ = [
     "process_index",
     "batch_sharding",
     "fit_sharding_to_rank",
+    "place_tree",
     "replicated",
     "shard_opt_state",
     "shard_params",

@@ -7,10 +7,20 @@ mode is cross-host divergence: a host stepping with different data/config
 silently corrupts the replicated state.  ``param_fingerprint`` reduces the
 parameter tree to one scalar; ``check_desync`` compares it across hosts via
 a broadcast from host 0 and raises on mismatch — cheap enough to run every
-epoch.
+epoch (or every N steps via the Trainer's ``desync_every_steps`` knob).
+
+Forensics (docs/observability.md, "Distributed"): before raising,
+``check_desync`` publishes this host's fingerprint into the metrics
+registry (``cluster_param_fingerprint{host=...}``), bumps
+``cluster_desync_events_total``, and records + dumps a flight-recorder
+``desync`` event naming the diverging host and step — so the post-mortem
+starts from WHICH host diverged and WHEN, not from a bare RuntimeError
+in one rank's logs.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 import jax
 import numpy as np
@@ -40,22 +50,62 @@ def param_fingerprint(tree) -> float:
     return acc
 
 
-def check_desync(tree, atol: float = 1e-4) -> None:
+def check_desync(tree, atol: float = 1e-4, *, step: Optional[int] = None,
+                 registry=None, flight=None, dump: bool = True) -> None:
     """Raise RuntimeError when any host's params diverge from host 0's.
 
     No-op in single-process runs.  The comparison crosses hosts with a
     broadcast_one_to_all (DCN), so the cost is one scalar per call.
+
+    Every call publishes this host's fingerprint as
+    ``cluster_param_fingerprint{host=<i>}``; on mismatch the diverging
+    host records a ``desync`` flight event (and dumps the ring, unless
+    ``dump=False``) naming itself, ``step``, and both fingerprints —
+    BEFORE the RuntimeError unwinds the process.
     """
     if jax.process_count() == 1:
         return
     from jax.experimental import multihost_utils
 
     mine = param_fingerprint(tree)
+    pid = jax.process_index()
+    try:
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        r = registry if registry is not None else default_registry()
+        r.gauge(
+            "cluster_param_fingerprint",
+            "per-host replicated-parameter fingerprint (desync detector)",
+            ("host",),
+        ).labels(host=pid).set(mine)
+    except Exception:
+        r = None  # forensics must never break the check itself
     host0 = float(
         multihost_utils.broadcast_one_to_all(np.asarray(mine, np.float64))
     )
     if abs(mine - host0) > atol * max(1.0, abs(host0)):
+        try:
+            if r is not None:
+                r.counter(
+                    "cluster_desync_events_total",
+                    "cross-host fingerprint divergences detected",
+                ).inc()
+            from ml_trainer_tpu.telemetry.flight import get_recorder
+
+            fr = flight if flight is not None else get_recorder()
+            info = {
+                "host": int(pid),
+                "step": int(step) if step is not None else None,
+                "fingerprint": mine,
+                "host0_fingerprint": host0,
+            }
+            fr.record("desync", **info)
+            if dump:
+                fr.dump("desync", **info)
+        except Exception:
+            pass
         raise RuntimeError(
-            f"replica desync detected: host {jax.process_index()} fingerprint "
+            f"replica desync detected: host {pid} fingerprint "
             f"{mine!r} != host 0 fingerprint {host0!r}"
+            + (f" (step {step})" if step is not None else "")
         )

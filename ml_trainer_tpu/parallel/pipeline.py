@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+from ml_trainer_tpu.parallel.comm_stats import account as _account
 from ml_trainer_tpu.parallel.compat import axis_size, shard_map
 
 
@@ -83,12 +84,16 @@ def _pipeline_local(params, x, *, stage_fn, axis_name, n_micro, remat):
         jnp.zeros(mb_shape, x.dtype),
         jnp.zeros((n_micro,) + mb_shape, x.dtype),
     )
+    # The hop inside tick() traces once but runs every scan iteration:
+    # account it here with the static tick count instead.
+    _account("ppermute", init[0], axis_name, times=n_micro + n_stages - 1)
     (_, outputs), _ = lax.scan(
         tick, init, jnp.arange(n_micro + n_stages - 1)
     )
     # Only the last stage holds real outputs; psum broadcasts them (every
     # other stage contributes zeros), matching the replicated out_spec.
     outputs = jnp.where(stage == n_stages - 1, outputs, 0.0)
+    _account("psum", outputs, axis_name)
     return lax.psum(outputs, axis_name)
 
 

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ml_trainer_tpu.parallel.collectives import ppermute_ring
+from ml_trainer_tpu.parallel.comm_stats import account as _comm_account
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ml_trainer_tpu.parallel.compat import axis_size, shard_map
 
@@ -80,6 +81,10 @@ def _ring_attention_local(q, k, v, *, axis_name, causal, scale):
         k,
         v,
     )
+    # The two ppermute_ring hops in step() trace ONCE inside fori_loop but
+    # execute n times each; top the comm accounting up by the remaining
+    # n-1 iterations (parallel/comm_stats.py).
+    _comm_account("ppermute", (k, v), axis_name, times=n - 1)
     m, l, o, _, _ = lax.fori_loop(0, n, step, init)
     return (o / l).astype(q.dtype)
 

@@ -107,10 +107,33 @@ def logical_to_shardings(
     return jax.tree_util.tree_map_with_path(resolve, tree)
 
 
+def place_tree(tree, shardings):
+    """Place a pytree onto per-leaf shardings, multi-host-safely.
+
+    Single-process this is plain per-leaf ``device_put``.  Multi-process,
+    ``device_put`` of a host/uncommitted value onto a NON-addressable
+    sharding makes jax verify the value is identical on every process —
+    one ``broadcast_one_to_all`` collective PER LEAF.  Besides being
+    O(leaves) DCN round-trips at construction time, the resulting storm
+    of back-to-back differently-sized collectives aborts the gloo CPU
+    backend of the 2-process test cluster (ops race on the TCP pairs:
+    ``op.preamble.length <= op.nbytes`` in gloo's pair.cc — each check
+    only syncs device 0's buffer, leaving the other local devices'
+    collectives in flight when the next one is issued).  A single jitted
+    identity with ``out_shardings`` places the WHOLE tree in one SPMD
+    program with zero cross-host traffic — each process contributes its
+    local values, the normal SPMD contract (the cross-host equality
+    guarantee comes from seeded determinism, audited by
+    ``parallel/desync.py``, not from per-leaf broadcasts)."""
+    if jax.process_count() == 1:
+        return jax.tree.map(jax.device_put, tree, shardings)
+    return jax.jit(lambda t: t, out_shardings=shardings)(tree)
+
+
 def shard_params(params, mesh: Mesh, rules: Optional[Rules] = None):
     """Materialize a parameter tree onto the mesh under the given rules."""
     shardings = logical_to_shardings(params, mesh, rules)
-    return jax.tree.map(jax.device_put, params, shardings)
+    return place_tree(params, shardings)
 
 
 def shard_opt_state(opt_state, mesh: Mesh, axis: str = "data"):

@@ -44,6 +44,14 @@ def _percentile(sorted_vals, q: float) -> float:
     return float(sorted_vals[i])
 
 
+# Registry-histogram buckets for the spec acceptance distribution: one
+# bucket per accepted-draft count.  Draft depths beyond 16 land in +Inf —
+# acceptable resolution loss (spec_k above 16 is outside the useful range,
+# docs/serving.md) in exchange for a FIXED bucket layout, which idempotent
+# registration requires.
+SPEC_ACCEPT_BUCKETS = tuple(float(i) for i in range(17))
+
+
 class ServingMetrics:
     """Thread-safe rolling serving metrics (bounded windows)."""
 
@@ -80,6 +88,10 @@ class ServingMetrics:
         self.spec_drafted_tokens = 0
         self.spec_accepted_tokens = 0
         self.spec_accept_hist: collections.Counter = collections.Counter()
+        # Watermark of what publish() already observed into the registry
+        # histogram: the snapshot is cumulative, histogram observations
+        # are not, so publish() feeds only the delta.
+        self._spec_hist_published: collections.Counter = collections.Counter()
         self._first_step_at: Optional[float] = None
         self._last_step_at: Optional[float] = None
 
@@ -230,23 +242,41 @@ class ServingMetrics:
 
     def publish(self, registry=None) -> dict:
         """Mirror the snapshot into the telemetry registry as
-        ``serving_*`` gauges (the spec acceptance histogram becomes a
-        labeled gauge), and return the snapshot.  Gauges, not counters:
-        the snapshot is a point-in-time view and several of its fields
-        legally move both ways (queue depth, occupancy)."""
+        ``serving_*`` gauges, and return the snapshot.  Gauges, not
+        counters: the snapshot is a point-in-time view and several of its
+        fields legally move both ways (queue depth, occupancy).
+
+        The spec acceptance distribution is the exception: it publishes
+        as the registry's REAL ``Histogram`` type
+        (``serving_spec_accept``, one bucket per accepted-draft count),
+        so Prometheus scrapes get proper cumulative ``_bucket{le=...}``
+        exposition and ``histogram_quantile`` works on it.  The snapshot
+        counts are cumulative while histogram observations are not, so a
+        per-instance watermark feeds only the delta — publish() stays
+        idempotent under repeated scrapes and safe under the concurrent
+        record/scrape hammer (the watermark update holds the instance
+        lock)."""
         from ml_trainer_tpu.telemetry.registry import default_registry
 
         r = registry if registry is not None else default_registry()
         snap = self.snapshot()
         for key, value in snap.items():
             if key == "spec_accept_hist":
-                g = r.gauge(
-                    "serving_spec_accept_hist",
-                    "verify steps by accepted-draft count",
-                    ("accepted",),
+                h = r.histogram(
+                    "serving_spec_accept",
+                    "accepted draft tokens per verify step per slot",
+                    buckets=SPEC_ACCEPT_BUCKETS,
                 )
-                for a, c in value.items():
-                    g.labels(accepted=a).set(c)
+                with self._lock:
+                    deltas = [
+                        (int(a), int(c) - self._spec_hist_published[int(a)])
+                        for a, c in value.items()
+                    ]
+                    for a, d in deltas:
+                        self._spec_hist_published[a] += max(d, 0)
+                for a, d in deltas:
+                    for _ in range(d):
+                        h.observe(float(a))
                 continue
             r.gauge(f"serving_{key}").set(float(value))
         return snap

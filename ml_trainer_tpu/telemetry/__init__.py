@@ -23,8 +23,19 @@ Four parts (docs/observability.md):
   events, dumped to ``flight_<ts>.json`` on NaN-rollback, preemption,
   watchdog trip, or unhandled exception — the crash forensics a
   post-mortem needs when the logs are gone (``flight.py``).
+* **cluster aggregation** — per-host heartbeats allgathered into
+  ``cluster_*{host=...}`` gauges on every host, a straggler detector
+  over the fenced step-time percentiles, desync forensics
+  (``parallel/desync.py`` publishes fingerprints here), analytic
+  collective-comms accounting (``parallel/comm_stats.py``), and the
+  end-of-run ``run_report.json``/``.md`` distillation (``cluster.py``).
 """
 
+from ml_trainer_tpu.telemetry.cluster import (
+    HEARTBEAT_FIELDS,
+    ClusterTelemetry,
+    write_run_report,
+)
 from ml_trainer_tpu.telemetry.export import JsonlSink, prometheus_text
 from ml_trainer_tpu.telemetry.flight import (
     FLIGHT_DIR_ENV,
@@ -70,4 +81,7 @@ __all__ = [
     "chip_peak_hbm_bytes",
     "train_step_flops",
     "TrainTelemetry",
+    "ClusterTelemetry",
+    "HEARTBEAT_FIELDS",
+    "write_run_report",
 ]

@@ -1,0 +1,383 @@
+"""Distributed observability: cross-host aggregation + run reports.
+
+PR 4's telemetry spine is strictly per-process — every host publishes
+into its own registry and host 0's Prometheus endpoint shows one host of
+an N-host pod.  This module closes the gap with the standard pod-scale
+diagnosis loop (MegaScale/ORBIT-style fleet forensics, PAPERS.md):
+
+* **per-host heartbeats** — each process keeps a tiny fixed-schema
+  vector of its own health numbers (last step, fenced step-ms p50/p99,
+  data-loader wait, throughput, skipped steps, collective bytes);
+* **aggregation** — :meth:`ClusterTelemetry.sync` allgathers the
+  heartbeat vectors (ONE small [F]-float64 array over DCN via
+  ``multihost_utils.process_allgather``; a no-op reshape when
+  single-process) and republishes every host's vector as
+  ``cluster_<field>{host=h}`` gauges — so host 0's ``/metrics`` scrape
+  and JSONL sink cover the whole pod;
+* **straggler detection** — a host whose fenced step-ms p50 exceeds the
+  cluster median by ``straggler_factor`` fires
+  ``cluster_straggler_events_total{host=h}`` and a flight-recorder
+  ``straggler`` event naming the host and step.  The median is the
+  LOWER median, so on a 2-host cluster the slow host is compared
+  against the fast one rather than against their midpoint;
+* **run report** — :func:`write_run_report` distills the registry, the
+  comm accounting, the span buffer and the flight ring into
+  ``run_report.json`` + ``run_report.md``: throughput, MFU, per-host
+  step percentiles, comm bytes by op, the skipped-steps/rollback
+  ledger, checkpoint write times, and every straggler/desync event.
+
+``sync()`` is a COLLECTIVE whenever ``jax.process_count() > 1``: every
+process must call it at the same point (the Trainer calls it at epoch
+boundaries, right after ``check_desync`` — same discipline).  Heartbeat
+updates are host-local and lock-cheap; call them as often as you like.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ml_trainer_tpu.telemetry import flight as _flight
+from ml_trainer_tpu.telemetry.registry import default_registry
+from ml_trainer_tpu.utils.logging import get_logger
+
+logger = get_logger("ml_trainer_tpu.telemetry")
+
+# One fixed, ordered schema: every host ships exactly this vector, so the
+# cross-host gather is a tiny static-shape array (no ragged dict sync).
+HEARTBEAT_FIELDS = (
+    "last_step",
+    "step_ms_p50",
+    "step_ms_p99",
+    "loader_wait_ms",
+    "samples_per_sec",
+    "skipped_steps_total",
+    "comm_bytes_total",
+)
+
+
+def _lower_median(vals) -> float:
+    """Median that never interpolates: with an even host count the lower
+    middle value is returned, so a 2-host cluster compares the slow host
+    against the FAST one (the midpoint would hide a 2x straggler)."""
+    s = sorted(vals)
+    return float(s[(len(s) - 1) // 2])
+
+
+class ClusterTelemetry:
+    """Per-host heartbeat + cross-host aggregation + straggler detector."""
+
+    def __init__(self, registry=None, flight=None,
+                 straggler_factor: float = 2.0):
+        if straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {straggler_factor}"
+            )
+        import jax
+
+        self.registry = registry if registry is not None else default_registry()
+        self.flight = flight if flight is not None else _flight.get_recorder()
+        self.straggler_factor = float(straggler_factor)
+        self.host = int(jax.process_index())
+        self.n_hosts = int(jax.process_count())
+        self._lock = threading.Lock()
+        self._local: Dict[str, float] = {f: 0.0 for f in HEARTBEAT_FIELDS}
+        r = self.registry
+        self._gauges = {
+            f: r.gauge(
+                f"cluster_{f}",
+                f"per-host {f.replace('_', ' ')} (aggregated heartbeat)",
+                ("host",),
+            )
+            for f in HEARTBEAT_FIELDS
+        }
+        self.g_hosts = r.gauge(
+            "cluster_hosts", "hosts seen in the last aggregation"
+        )
+        self.g_sync_age = r.gauge(
+            "cluster_last_sync_unixtime", "wall time of the last aggregation"
+        )
+        self.c_syncs = r.counter(
+            "cluster_syncs_total", "cross-host aggregation rounds"
+        )
+        self.c_straggler = r.counter(
+            "cluster_straggler_events_total",
+            "aggregation rounds in which this host exceeded "
+            "straggler_factor x the cluster-median step time",
+            ("host",),
+        )
+
+    # -- host-local -----------------------------------------------------
+    def heartbeat(self, **fields) -> None:
+        """Update this host's heartbeat values (any subset of
+        ``HEARTBEAT_FIELDS``).  Host-local, lock-cheap — safe at the
+        trainer's per-sync cadence."""
+        unknown = set(fields) - set(HEARTBEAT_FIELDS)
+        if unknown:
+            raise ValueError(
+                f"unknown heartbeat fields {sorted(unknown)}; "
+                f"expected a subset of {HEARTBEAT_FIELDS}"
+            )
+        with self._lock:
+            for k, v in fields.items():
+                self._local[k] = float(v)
+
+    def local_vector(self) -> np.ndarray:
+        with self._lock:
+            return np.asarray(
+                [self._local[f] for f in HEARTBEAT_FIELDS], np.float64
+            )
+
+    # -- cross-host -----------------------------------------------------
+    def sync(self, step: Optional[int] = None) -> np.ndarray:
+        """Gather every host's heartbeat and republish the cluster view.
+
+        COLLECTIVE when multi-process (every process must call it at the
+        same program point); a pure local publish when single-process.
+        Returns the gathered ``[n_hosts, len(HEARTBEAT_FIELDS)]`` matrix.
+        """
+        vec = self.local_vector()
+        if self.n_hosts > 1:
+            from jax.experimental import multihost_utils
+
+            all_vecs = np.asarray(
+                multihost_utils.process_allgather(vec), np.float64
+            ).reshape(self.n_hosts, len(HEARTBEAT_FIELDS))
+        else:
+            all_vecs = vec[None, :]
+        self._ingest(all_vecs, step=step)
+        return all_vecs
+
+    def _ingest(self, all_vecs: np.ndarray, step: Optional[int] = None) -> None:
+        """Publish one gathered heartbeat matrix as ``cluster_*{host=}``
+        gauges and run straggler detection over it.  Split from ``sync``
+        so single-process tests can inject a fabricated pod."""
+        all_vecs = np.asarray(all_vecs, np.float64)
+        for h in range(all_vecs.shape[0]):
+            for i, f in enumerate(HEARTBEAT_FIELDS):
+                self._gauges[f].labels(host=h).set(float(all_vecs[h, i]))
+        self.g_hosts.set(all_vecs.shape[0])
+        self.g_sync_age.set(time.time())
+        self.c_syncs.inc()
+        self._detect_stragglers(all_vecs, step=step)
+
+    def _detect_stragglers(self, all_vecs: np.ndarray,
+                           step: Optional[int] = None) -> None:
+        col = HEARTBEAT_FIELDS.index("step_ms_p50")
+        times = all_vecs[:, col]
+        live = [float(t) for t in times if t > 0]
+        if len(live) < 2:
+            return  # one host (or no data): no cluster to straggle behind
+        median = _lower_median(live)
+        if median <= 0:
+            return
+        for h, t in enumerate(times):
+            if t > self.straggler_factor * median:
+                self.c_straggler.labels(host=h).inc()
+                self.flight.record(
+                    "straggler",
+                    host=int(h),
+                    step=int(step) if step is not None else None,
+                    step_ms_p50=round(float(t), 3),
+                    cluster_median_ms=round(median, 3),
+                    factor=round(float(t) / median, 2),
+                )
+                logger.warning(
+                    f"straggler: host {h} step p50 {t:.1f}ms vs cluster "
+                    f"median {median:.1f}ms "
+                    f"(>{self.straggler_factor:g}x, step {step})"
+                )
+
+    def cluster_view(self) -> Dict[str, Dict[str, float]]:
+        """The last published cluster state, host -> field -> value (from
+        the registry — available on any host after a ``sync``)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for f, g in self._gauges.items():
+            for key, v in g.series().items():
+                out.setdefault(key[0], {})[f] = float(v)
+        return out
+
+
+# ---------------------------------------------------------------- report
+def _ckpt_write_stats() -> dict:
+    """Checkpoint write-time stats harvested from the span buffer."""
+    from ml_trainer_tpu.telemetry.spans import trace_events
+
+    durs = {}
+    for ev in trace_events():
+        if ev.get("name") in ("ckpt_write", "ckpt_write_io") and "dur" in ev:
+            durs.setdefault(ev["name"], []).append(ev["dur"] / 1e3)  # ms
+    out = {}
+    for name, ms in durs.items():
+        s = sorted(ms)
+        out[name] = {
+            "count": len(s),
+            "total_ms": round(sum(s), 3),
+            "p50_ms": round(s[(len(s) - 1) // 2], 3),
+            "max_ms": round(s[-1], 3),
+        }
+    return out
+
+
+def _markdown_report(report: dict) -> str:
+    lines = [
+        "# Run report",
+        "",
+        f"* **reason**: {report['reason']}",
+        f"* **written at**: {report['written_at_iso']}",
+        f"* **hosts**: {report.get('n_hosts', 1)}",
+        "",
+        "## Throughput",
+        "",
+    ]
+    thr = report.get("throughput", {})
+    for k in sorted(thr):
+        lines.append(f"* {k}: {thr[k]}")
+    hosts = report.get("hosts", {})
+    if hosts:
+        lines += ["", "## Per-host heartbeat", ""]
+        fields = list(HEARTBEAT_FIELDS)
+        lines.append("| host | " + " | ".join(fields) + " |")
+        lines.append("|---" * (len(fields) + 1) + "|")
+        for h in sorted(hosts, key=lambda x: int(x)):
+            row = hosts[h]
+            lines.append(
+                f"| {h} | "
+                + " | ".join(str(row.get(f, "")) for f in fields)
+                + " |"
+            )
+    comm = report.get("comm_bytes_by_op", {})
+    lines += ["", "## Collective comms (analytic, trace-time)", ""]
+    if comm:
+        lines.append("| op | bytes |")
+        lines.append("|---|---|")
+        for op in sorted(comm):
+            lines.append(f"| {op} | {int(comm[op]):,} |")
+    else:
+        lines.append("no explicit collectives traced")
+    res = report.get("resilience", {})
+    lines += [
+        "",
+        "## Resilience ledger",
+        "",
+        f"* skipped steps per epoch: {res.get('skipped_steps', [])}",
+        f"* rollbacks: {res.get('rollbacks', 0)}",
+        f"* straggler events: {res.get('straggler_events', 0)}",
+        f"* desync events: {res.get('desync_events', 0)}",
+    ]
+    ckpt = report.get("checkpoint_writes", {})
+    if ckpt:
+        lines += ["", "## Checkpoint writes", ""]
+        for name in sorted(ckpt):
+            c = ckpt[name]
+            lines.append(
+                f"* {name}: {c['count']} write(s), p50 {c['p50_ms']}ms, "
+                f"max {c['max_ms']}ms"
+            )
+    events = report.get("events", [])
+    if events:
+        lines += ["", "## Straggler / desync / rollback events", ""]
+        for ev in events:
+            lines.append(f"* `{json.dumps(ev, default=str)}`")
+    return "\n".join(lines) + "\n"
+
+
+def write_run_report(out_dir: str, *, history: Optional[dict] = None,
+                     registry=None, flight=None, reason: str = "completed",
+                     extra: Optional[dict] = None) -> dict:
+    """Distill the telemetry spine into ``run_report.json`` + a markdown
+    twin and return the report dict (paths under ``report['paths']``).
+
+    Called by the Trainer at the end of ``fit()`` (and best-effort on a
+    crash, right after the flight-recorder dump) — but freestanding, so
+    any driver that populated the registry can emit one.  Writes are
+    atomic (tmp + rename) and never raise: a report must not take down
+    the run it is documenting.
+    """
+    registry = registry if registry is not None else default_registry()
+    flight = flight if flight is not None else _flight.get_recorder()
+    snap = registry.snapshot()
+
+    def pick(prefix: str) -> dict:
+        return {
+            k: v for k, v in snap.items()
+            if k.startswith(prefix) and "{" not in k
+        }
+
+    # Per-host cluster view, parsed back from the labeled gauge snapshot.
+    hosts: Dict[str, dict] = {}
+    for f in HEARTBEAT_FIELDS:
+        key_prefix = f"cluster_{f}{{host="
+        for k, v in snap.items():
+            if k.startswith(key_prefix):
+                h = k[len(key_prefix):-1]
+                hosts.setdefault(h, {})[f] = v
+
+    from ml_trainer_tpu.parallel.comm_stats import comm_bytes, comm_calls
+
+    event_kinds = ("straggler", "desync", "rollback", "preemption",
+                   "nonfinite_steps")
+    events = [r for r in flight.records() if r.get("kind") in event_kinds]
+    straggler_events = int(sum(
+        v for k, v in snap.items()
+        if k.startswith("cluster_straggler_events_total")
+    ))
+    desync_events = int(snap.get("cluster_desync_events_total", 0))
+    history = history or {}
+    report = {
+        "reason": reason,
+        "written_at": time.time(),
+        "written_at_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "n_hosts": max(len(hosts), 1),
+        "throughput": {
+            k: snap[k]
+            for k in (
+                "train_samples_per_sec", "train_tokens_per_sec", "train_mfu",
+                "train_steps_total", "train_step_ms_p50", "train_step_ms_p99",
+                "train_comm_bytes_per_step", "train_comm_compute_ratio",
+            )
+            if k in snap
+        },
+        "train_gauges": pick("train_"),
+        "hosts": hosts,
+        "comm_bytes_by_op": {k: round(v, 1) for k, v in comm_bytes().items()},
+        "comm_calls_by_op": comm_calls(),
+        "resilience": {
+            "skipped_steps": history.get("skipped_steps", []),
+            "rollbacks": history.get("rollbacks", 0),
+            "straggler_events": straggler_events,
+            "desync_events": desync_events,
+        },
+        "checkpoint_writes": _ckpt_write_stats(),
+        "history": {
+            k: history[k]
+            for k in ("epochs", "train_loss", "val_loss")
+            if k in history
+        },
+        "events": events[-64:],
+    }
+    if extra:
+        report.update(extra)
+    json_path = os.path.join(out_dir, "run_report.json")
+    md_path = os.path.join(out_dir, "run_report.md")
+    try:
+        os.makedirs(out_dir, exist_ok=True)
+        tmp = json_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fp:
+            json.dump(report, fp, indent=1, default=str)
+        os.replace(tmp, json_path)
+        tmp = md_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fp:
+            fp.write(_markdown_report(report))
+        os.replace(tmp, md_path)
+        report["paths"] = {"json": json_path, "md": md_path}
+        logger.info(f"run report written: {json_path}")
+    except OSError as e:
+        logger.error(f"run report write failed ({json_path}): {e}")
+        report["paths"] = {}
+    return report

@@ -19,6 +19,7 @@ a CPU has no denominator worth printing).
 
 from __future__ import annotations
 
+import collections
 import time
 from typing import Any, Optional, Sequence
 
@@ -80,7 +81,7 @@ class TrainTelemetry:
     def __init__(self, model: Any = None, model_name: str = "",
                  global_batch: int = 0,
                  batch_shape: Optional[Sequence[int]] = None,
-                 registry=None, flight=None, log=None):
+                 registry=None, flight=None, log=None, cluster=None):
         self.registry = registry if registry is not None else default_registry()
         self.flight = flight if flight is not None else _flight.get_recorder()
         self.log = log if log is not None else logger
@@ -102,6 +103,18 @@ class TrainTelemetry:
         self._last_sync_t: Optional[float] = None
         self._last_sync_step = 0
         self._last_skipped = 0
+        # Distributed-observability hooks (telemetry/cluster.py): a rolling
+        # window of per-step ms (fenced at the sync cadence — the stats
+        # fetch above anchors each window to real execution), the loader
+        # wait accounting, and the optional cluster heartbeat target.
+        self.cluster = cluster
+        self._step_ms: collections.deque = collections.deque(maxlen=128)
+        from ml_trainer_tpu.data.loader import loader_wait_snapshot
+
+        self._loader_wait_snapshot = loader_wait_snapshot
+        self._last_wait = loader_wait_snapshot()
+        self.last_loader_wait_ms = 0.0
+        self.last_sps = 0.0
         # Instruments (idempotent registration; shared default registry).
         r = self.registry
         self.g_loss = r.gauge("train_loss", "last fetched train-step loss")
@@ -127,6 +140,28 @@ class TrainTelemetry:
         self.c_rollbacks = r.counter(
             "train_rollbacks_total", "rollback-to-last-good events"
         )
+        self.g_step_p50 = r.gauge(
+            "train_step_ms_p50",
+            "median per-step ms (windows fenced at the sync cadence)",
+        )
+        self.g_step_p99 = r.gauge(
+            "train_step_ms_p99", "p99 per-step ms (sync-fenced windows)"
+        )
+        self.g_loader_wait = r.gauge(
+            "train_loader_wait_ms",
+            "host ms blocked per batch in the input pipeline",
+        )
+        self.g_comm_bytes = r.gauge(
+            "train_comm_bytes_per_step",
+            "analytic explicit-collective bytes per compiled step "
+            "(parallel/comm_stats.py; zero when only XLA-implied "
+            "collectives run)",
+        )
+        self.g_comm_ratio = r.gauge(
+            "train_comm_compute_ratio",
+            "analytic collective bytes per training FLOP — the "
+            "sharding-bug canary next to MFU",
+        )
 
     def on_sync(self, step: int, stats: dict, *, epoch: int = 0,
                 skipped_total: int = 0, lr_scale: float = 1.0) -> dict:
@@ -144,6 +179,7 @@ class TrainTelemetry:
         if self._last_sync_t is not None and steps_d > 0:
             dt = max(now - self._last_sync_t, 1e-9)
             sps = steps_d * self.global_batch / dt
+            self.last_sps = sps
             self.g_sps.set(sps)
             if self.tokens_per_sample:
                 tps = sps * self.tokens_per_sample
@@ -151,8 +187,35 @@ class TrainTelemetry:
             if self.flops_per_step is not None and self._peak:
                 mfu = (steps_d * self.flops_per_step / dt) / self._peak
                 self.g_mfu.set(mfu)
+            # One window entry = mean per-step ms of this sync window; the
+            # device fetch above fenced the window's work, so percentiles
+            # over windows are honest (exact per-step at log_every=1).
+            self._step_ms.append(dt / steps_d * 1e3)
+            p50, p99 = self.step_ms_p50(), self.step_ms_p99()
+            self.g_step_p50.set(p50)
+            self.g_step_p99.set(p99)
         self._last_sync_t = now
         self._last_sync_step = step
+        # Data-loader lag: host ms blocked per batch since the last sync.
+        wait_s, wait_b = self._loader_wait_snapshot()
+        batches_d = wait_b - self._last_wait[1]
+        if batches_d > 0:
+            self.last_loader_wait_ms = (
+                (wait_s - self._last_wait[0]) / batches_d * 1e3
+            )
+            self.g_loader_wait.set(self.last_loader_wait_ms)
+        self._last_wait = (wait_s, wait_b)
+        # Analytic collective-comms accounting (trace-time, so the total
+        # for a once-compiled step IS bytes-per-step) and the
+        # comms/compute ratio beside MFU.
+        from ml_trainer_tpu.parallel.comm_stats import comm_bytes_total
+
+        comm_b = comm_bytes_total()
+        comm_ratio = None
+        self.g_comm_bytes.set(comm_b)
+        if self.flops_per_step:
+            comm_ratio = comm_b / self.flops_per_step
+            self.g_comm_ratio.set(comm_ratio)
         skipped_d = skipped_total - self._last_skipped
         self._last_skipped = skipped_total
         self.g_loss.set(host["loss_raw"])
@@ -180,6 +243,14 @@ class TrainTelemetry:
             event["tokens_per_sec"] = round(tps, 1)
         if mfu is not None:
             event["mfu"] = round(mfu, 4)
+        if self._step_ms:
+            event["step_ms_p50"] = round(self.step_ms_p50(), 3)
+            event["step_ms_p99"] = round(self.step_ms_p99(), 3)
+        event["loader_wait_ms"] = round(self.last_loader_wait_ms, 3)
+        if comm_b:
+            event["comm_bytes_per_step"] = round(comm_b, 1)
+        if comm_ratio is not None:
+            event["comm_compute_ratio"] = comm_ratio
         self.log.info("train_step_telemetry", **event)
         self.flight.record("train_step", **event)
         if skipped_d > 0:
@@ -199,4 +270,30 @@ class TrainTelemetry:
         sink = default_sink()
         if sink is not None:
             sink.write(event, kind="train_step")
+        if self.cluster is not None:
+            # Host-local heartbeat refresh; the cross-host allgather stays
+            # at the Trainer's epoch boundary (collective discipline).
+            self.cluster.heartbeat(
+                last_step=step,
+                step_ms_p50=self.step_ms_p50(),
+                step_ms_p99=self.step_ms_p99(),
+                loader_wait_ms=self.last_loader_wait_ms,
+                samples_per_sec=self.last_sps,
+                skipped_steps_total=skipped_total,
+                comm_bytes_total=comm_b,
+            )
         return host
+
+    def _percentile(self, q: float) -> float:
+        if not self._step_ms:
+            return 0.0
+        s = sorted(self._step_ms)
+        return float(s[min(len(s) - 1, int(q * (len(s) - 1) + 0.5))])
+
+    def step_ms_p50(self) -> float:
+        """Median per-step ms over the recent sync-fenced windows (0.0
+        before the first complete window)."""
+        return self._percentile(0.5)
+
+    def step_ms_p99(self) -> float:
+        return self._percentile(0.99)

@@ -196,6 +196,8 @@ class Trainer:
         handle_preemption: bool = True,
         telemetry: bool = False,
         log_every_steps: Optional[int] = None,
+        desync_every_steps: Optional[int] = None,
+        straggler_factor: float = 2.0,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -325,6 +327,21 @@ class Trainer:
         steps) — the progress-bar fetch, rollback check, and telemetry
         emission all ride this clock, so lowering it trades throughput
         for observability granularity.
+
+        ``desync_every_steps``: additionally run the cross-host
+        replica-desync check every N optimizer steps (default None =
+        epoch boundaries only, the PR-3 behavior).  Each check costs one
+        scalar broadcast over DCN plus the local fingerprint fetch; on
+        mismatch the diverging host records + dumps a flight event
+        naming itself and the step before raising
+        (``parallel/desync.py``).  No-op single-process.
+
+        ``straggler_factor``: with ``telemetry=True``, a host whose
+        fenced step-time p50 exceeds the cluster (lower-)median by this
+        factor at an aggregation point fires
+        ``cluster_straggler_events_total{host=...}`` and a flight event
+        (``telemetry/cluster.py``; heartbeats allgather at epoch
+        boundaries).  Must be > 1.
 
         ``handle_preemption`` (default True): ``fit()`` installs
         SIGTERM/SIGINT handlers (restored on exit) that finish the
@@ -475,6 +492,16 @@ class Trainer:
                     f"log_every_steps must be >= 1, got {log_every_steps}"
                 )
             self.log_every = int(log_every_steps)
+        if desync_every_steps is not None and desync_every_steps < 1:
+            raise ValueError(
+                f"desync_every_steps must be >= 1, got {desync_every_steps}"
+            )
+        self.desync_every_steps = desync_every_steps
+        if straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {straggler_factor}"
+            )
+        self.straggler_factor = float(straggler_factor)
         from ml_trainer_tpu.telemetry.flight import get_recorder
         from ml_trainer_tpu.telemetry.spans import (
             PROFILE_ENV,
@@ -484,6 +511,7 @@ class Trainer:
 
         self._flight = get_recorder()
         self._telemetry: Optional[Any] = None  # built with the loaders
+        self._cluster: Optional[Any] = None  # built with the telemetry
         self._profiler = StepProfiler("train")
         # Per-step profiler polling only when something can trigger it.
         self._profile_hook = bool(
@@ -806,14 +834,24 @@ class Trainer:
             else:
                 # Replicated params (pure DP, incl. the single-chip tunnel
                 # where eager per-op dispatch is the hazard): jit is safe,
-                # the map re-places everything replicated anyway.
-                opt_state = jax.tree.map(
-                    lambda x: x
-                    if isinstance(
-                        getattr(x, "sharding", None), jax.sharding.NamedSharding
-                    )
-                    else jax.device_put(x, self._replicated),
-                    jax.jit(self.tx.init)(params),
+                # the placement re-places everything replicated anyway.
+                # place_tree, not per-leaf device_put: multi-host the leaf
+                # storm is both O(leaves) DCN broadcasts and a gloo-CPU
+                # abort (parallel/sharding.py).
+                from ml_trainer_tpu.parallel import place_tree
+
+                opt_raw = jax.jit(self.tx.init)(params)
+                opt_state = place_tree(
+                    opt_raw,
+                    jax.tree.map(
+                        lambda x: x.sharding
+                        if isinstance(
+                            getattr(x, "sharding", None),
+                            jax.sharding.NamedSharding,
+                        )
+                        else self._replicated,
+                        opt_raw,
+                    ),
                 )
             if self._shard_opt_state:
                 # Model-sharded params (TP/FSDP rules): re-place only the
@@ -826,21 +864,36 @@ class Trainer:
             jax.tree.map(jnp.copy, params) if self.ema_decay is not None
             else None
         )
+        # The replicated host-side scalars (step/rng/guard counters) place
+        # in ONE program — see place_tree for why per-leaf device_put is
+        # not multi-host-safe.
+        from ml_trainer_tpu.parallel import place_tree
+
+        scalars = place_tree(
+            {
+                "step": jnp.zeros((), jnp.int32),
+                "rng": state_rng,
+                "skipped": jnp.zeros((), jnp.int32),
+                "streak": jnp.zeros((), jnp.int32),
+            },
+            {
+                "step": self._replicated,
+                "rng": self._replicated,
+                "skipped": self._replicated,
+                "streak": self._replicated,
+            },
+        )
         self.state = TrainState(
-            step=jax.device_put(jnp.zeros((), jnp.int32), self._replicated),
+            step=scalars["step"],
             params=params,
             opt_state=opt_state,
             batch_stats=batch_stats,
-            rng=jax.device_put(state_rng, self._replicated),
+            rng=scalars["rng"],
             ema_params=ema_params,
             # Guard counters ride in the state so the compiled step can
             # maintain them without a host sync (fetched once per epoch).
-            skipped_steps=jax.device_put(
-                jnp.zeros((), jnp.int32), self._replicated
-            ),
-            bad_streak=jax.device_put(
-                jnp.zeros((), jnp.int32), self._replicated
-            ),
+            skipped_steps=scalars["skipped"],
+            bad_streak=scalars["streak"],
         )
         self._state_shardings = jax.tree.map(lambda x: x.sharding, self.state)
         if self._sharded_ckpt is None:
@@ -860,14 +913,23 @@ class Trainer:
                     "checkpoints (sharded_checkpoint=True)."
                 )
         if self.telemetry:
+            from ml_trainer_tpu.telemetry.cluster import ClusterTelemetry
             from ml_trainer_tpu.telemetry.train_metrics import TrainTelemetry
 
+            # Cluster aggregation rides the telemetry flag: host-local
+            # heartbeats at every sync, ONE small allgather per epoch
+            # (degenerate single-host publish when not distributed).
+            self._cluster = ClusterTelemetry(
+                flight=self._flight,
+                straggler_factor=self.straggler_factor,
+            )
             self._telemetry = TrainTelemetry(
                 model=self.model,
                 model_name=type(self.model).__name__,
                 global_batch=self.global_batch,
                 batch_shape=(self.global_batch,) + tuple(sample_x.shape[1:]),
                 flight=self._flight,
+                cluster=self._cluster,
             )
         train_step = self._make_train_step()
         # Pin the output state to the SAME shardings it was born with: the
@@ -1247,6 +1309,22 @@ class Trainer:
                                 self._lr_scale, jnp.float32
                             )
                     if (
+                        self.desync_every_steps
+                        and process_count() > 1
+                        and gstep % self.desync_every_steps == 0
+                    ):
+                        # Step-granular desync forensics: same gstep on
+                        # every host (loaders are length-identical), so
+                        # all hosts enter the broadcast together.
+                        from ml_trainer_tpu.parallel.desync import (
+                            check_desync,
+                        )
+
+                        check_desync(
+                            self.state.params, step=gstep,
+                            flight=self._flight,
+                        )
+                    if (
                         self.save_every_steps
                         and done % self.save_every_steps == 0
                         and done < n
@@ -1329,6 +1407,7 @@ class Trainer:
                     self._profiler.on_step((epoch - 1) * n + done)
                 tepoch.update(k)
                 log(k, loss, stats)
+                self._maybe_check_desync(epoch, n, done, k)
                 if self._preempt_requested:
                     return loss_sum, metric_sum
             for x, y in prefetch_to_device(
@@ -1342,9 +1421,28 @@ class Trainer:
                 done += 1
                 tepoch.update(1)
                 log(1, loss, stats)
+                self._maybe_check_desync(epoch, n, done, 1)
                 if self._preempt_requested:
                     return loss_sum, metric_sum
         return loss_sum, metric_sum
+
+    def _maybe_check_desync(self, epoch: int, n: int, done: int,
+                            step_n: int) -> None:
+        """Multi-step-path desync cadence: fire when a multiple of
+        ``desync_every_steps`` landed inside the last dispatch of
+        ``step_n`` steps.  ``done`` is host-deterministic, so every host
+        joins the broadcast at the same dispatch."""
+        if (
+            self.desync_every_steps
+            and process_count() > 1
+            and done % self.desync_every_steps < step_n
+        ):
+            from ml_trainer_tpu.parallel.desync import check_desync
+
+            check_desync(
+                self.state.params, step=(epoch - 1) * n + done,
+                flight=self._flight,
+            )
 
     def _validate_one_epoch(self) -> None:
         n = len(self.val_loader)
@@ -1427,11 +1525,14 @@ class Trainer:
             self._fit(resume)
         except Exception as e:
             # Crash forensics: the last N step records + the error, on
-            # disk before the exception unwinds the process.
+            # disk before the exception unwinds the process — followed by
+            # a best-effort run report so the post-mortem starts from the
+            # distilled numbers, not raw logs.
             self._flight.dump(
                 "unhandled_exception", out_dir=self._flight_dir(),
                 error=f"{type(e).__name__}: {e}",
             )
+            self._write_run_report(f"crash: {type(e).__name__}: {e}")
             raise
         finally:
             self._restore_preempt_handlers(prev_handlers)
@@ -1517,7 +1618,17 @@ class Trainer:
                 # SURVEY.md §5) — one scalar over DCN per epoch.
                 from ml_trainer_tpu.parallel.desync import check_desync
 
-                check_desync(self.state.params)
+                check_desync(
+                    self.state.params, step=epoch * self.steps_per_epoch,
+                    flight=self._flight,
+                )
+            if self._cluster is not None:
+                # Cluster heartbeat aggregation: one tiny allgather per
+                # epoch, every host at the same program point (the same
+                # collective discipline as check_desync above).  After it,
+                # host 0's /metrics and JSONL sink carry cluster_* series
+                # for the whole pod.
+                self._cluster.sync(step=epoch * self.steps_per_epoch)
             # Save on the primary host only (ref: src/trainer.py:252-254).
             # When params are genuinely PARTITIONED across hosts (TP/FSDP
             # multi-host), the fetch is a global allgather — a collective —
@@ -1598,6 +1709,7 @@ class Trainer:
         if self.save_history and is_primary():
             self.save_history_(self.model_dir)
         ckpt.wait_for_checkpoints()
+        self._write_run_report("preempted" if self.preempted else "completed")
         logger.info("Training Complete.")
 
     def _out_of_patience(self) -> bool:
@@ -1717,6 +1829,26 @@ class Trainer:
 
         return os.environ.get(FLIGHT_DIR_ENV) or self.model_dir
 
+    def _write_run_report(self, reason: str) -> None:
+        """End-of-run distillation (docs/observability.md run-report
+        schema): throughput/MFU, per-host heartbeats, comm bytes by op,
+        the resilience ledger, checkpoint write times, straggler/desync
+        events.  Primary host, telemetry runs only; never raises (the
+        crash path calls this while an exception is in flight)."""
+        if not self.telemetry or not is_primary():
+            return
+        try:
+            from ml_trainer_tpu.telemetry.cluster import write_run_report
+
+            write_run_report(
+                self.model_dir,
+                history=self.history or self._partial_history(),
+                flight=self._flight,
+                reason=reason,
+            )
+        except Exception as e:  # the report documents the run, never ends it
+            logger.warning(f"run report write failed: {e}")
+
     def _maybe_rollback(self, gstep: int = 0) -> bool:
         """Rollback-to-last-good: when ``rollback_bad_steps`` CONSECUTIVE
         steps were skipped as non-finite, restore the newest checkpoint
@@ -1772,7 +1904,9 @@ class Trainer:
             state, _, _ = ckpt.restore_checkpoint(
                 latest, ckpt.fetch_to_host(self.state)
             )
-            self.state = jax.device_put(state, self._state_shardings)
+            from ml_trainer_tpu.parallel import place_tree
+
+            self.state = place_tree(state, self._state_shardings)
         # Keep the cumulative skipped count (diagnostics) but clear the
         # streak — the restored counters predate the event.
         self.state = self.state.replace(
@@ -1946,7 +2080,9 @@ class Trainer:
 
             state = multihost_utils.broadcast_one_to_all(state)
             scalars = np.asarray(multihost_utils.broadcast_one_to_all(scalars))
-        self.state = jax.device_put(state, self._state_shardings)
+        from ml_trainer_tpu.parallel import place_tree
+
+        self.state = place_tree(state, self._state_shardings)
         # History lists are only written from the primary host, which has
         # them from its local checkpoint (ref: src/trainer.py:252-254).
         self.train_losses = list(saved.get("train_loss", []))

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Bench regression gate — the committed BENCH trajectory finally gates.
+
+VERDICT r5 flagged that the ``BENCH_r*.json`` trajectory the driver
+commits every round measures but never *enforces* anything: a PR could
+halve samples/s and tier-1 would stay green.  This script closes the
+loop as a fastlane leg:
+
+1. measure a fresh headline row through the real Trainer step
+   (``bench.bench_parity`` — the identical code path the committed rows
+   used), best-of-``--reps`` to step over scheduler noise;
+2. compare against the newest committed ``BENCH_r*.json`` row measured
+   on the SAME backend (rows without an explicit ``backend`` field are
+   classified by their CPU-fallback note).  Within ``--threshold``
+   (default 10%) of the trajectory → pass;
+3. a machine can be legitimately slower than the one that produced the
+   committed rows (containers differ round to round), and a gate that
+   always fails is worse than none — so when the trajectory check
+   misses, the gate falls back to a MACHINE-LOCAL baseline
+   (``.bench_gate_baseline.json`` in the repo root, keyed by a CPU
+   fingerprint).  First contact on an unmatched machine calibrates the
+   baseline and passes with a note; every later run on that machine
+   fails hard when the fresh number drops >``--threshold`` below the
+   recorded best.  The baseline ratchets upward on every pass, so the
+   gate tightens as the machine shows what it can do.
+
+Exit non-zero = regression.  Threshold override:
+``ML_TRAINER_TPU_BENCH_GATE_THRESHOLD`` (fraction, e.g. ``0.15``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_FILE = os.path.join(REPO, ".bench_gate_baseline.json")
+
+
+def machine_fingerprint() -> str:
+    """Coarse same-machine identity: CPU model x core count.  Good enough
+    to tell 'this container' from 'the container that measured r05'."""
+    model = ""
+    try:
+        with open("/proc/cpuinfo") as fp:
+            for line in fp:
+                if line.lower().startswith("model name"):
+                    model = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        import platform
+
+        model = platform.processor() or platform.machine()
+    return f"{model} x{os.cpu_count()}"
+
+
+def row_backend(row: dict) -> str:
+    """Backend a committed row was measured on.  Old rows predate the
+    explicit field; their CPU-fallback note is the tell."""
+    backend = row.get("backend")
+    if backend:
+        return str(backend)
+    return "cpu" if "CPU fallback" in str(row.get("note") or "") else "tpu"
+
+
+def committed_rows(repo: str = REPO) -> list:
+    """(round, row) for every parseable committed BENCH artifact, round
+    ascending."""
+    out = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            data = json.load(open(path))
+        except (OSError, ValueError):
+            continue
+        row = data.get("parsed") or {}
+        if isinstance(row, dict) and isinstance(row.get("value"), (int, float)):
+            out.append((int(m.group(1)), row))
+    return out
+
+
+def reference_for(backend: str, rows=None):
+    """The newest committed row measured on ``backend`` (None if none)."""
+    rows = committed_rows() if rows is None else rows
+    matching = [(r, row) for r, row in rows if row_backend(row) == backend]
+    return matching[-1] if matching else None
+
+
+def load_baseline(backend: str, fingerprint: str,
+                  path: str = BASELINE_FILE):
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    entry = data.get(backend)
+    if not entry or entry.get("fingerprint") != fingerprint:
+        return None
+    value = entry.get("samples_per_sec")
+    return float(value) if isinstance(value, (int, float)) else None
+
+
+def save_baseline(backend: str, fingerprint: str, value: float,
+                  path: str = BASELINE_FILE) -> None:
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        data = {}
+    data[backend] = {
+        "fingerprint": fingerprint,
+        "samples_per_sec": round(value, 1),
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fp:
+        json.dump(data, fp, indent=1)
+    os.replace(tmp, path)
+
+
+def evaluate(fresh: float, committed_ref, local_baseline,
+             threshold: float) -> dict:
+    """Gate decision, separated for unit testing.
+
+    ``committed_ref``: newest same-backend committed samples/s (or None).
+    ``local_baseline``: this machine's recorded best (or None).
+    Pass when the fresh rate holds the committed trajectory; else fail
+    against the local baseline; else calibrate (pass + record).
+    """
+    result = {
+        "fresh_samples_per_sec": round(fresh, 1),
+        "committed_reference": committed_ref,
+        "local_baseline": local_baseline,
+        "threshold": threshold,
+    }
+    if committed_ref and fresh >= (1.0 - threshold) * committed_ref:
+        result.update(ok=True, decided_by="committed_trajectory")
+        return result
+    if local_baseline:
+        ok = fresh >= (1.0 - threshold) * local_baseline
+        result.update(
+            ok=ok,
+            decided_by="local_baseline",
+            ratio_vs_baseline=round(fresh / local_baseline, 3),
+        )
+        return result
+    if committed_ref:
+        result.update(
+            ok=True, decided_by="calibration",
+            note="machine slower than the committed trajectory and no "
+            "local baseline yet; recording this run as the baseline",
+        )
+        return result
+    result.update(
+        ok=True, decided_by="no_reference",
+        note="no committed row for this backend; nothing to gate against",
+    )
+    return result
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--threshold", type=float, default=float(
+        os.environ.get("ML_TRAINER_TPU_BENCH_GATE_THRESHOLD", "0.10")
+    ), help="max allowed fractional regression (default 0.10)")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--reps", type=int, default=2,
+                        help="measurement passes; best rate is compared "
+                        "(the standard noise-floor trick)")
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    backend = jax.default_backend()
+    fp = machine_fingerprint()
+    ref = reference_for(backend)
+    baseline = load_baseline(backend, fp)
+
+    import bench  # the committed rows were measured through this module
+
+    fresh = 0.0
+    for _ in range(max(args.reps, 1)):
+        fresh = max(fresh, bench.bench_parity(args.batch_size))
+
+    result = evaluate(
+        fresh, float(ref[1]["value"]) if ref else None, baseline,
+        args.threshold,
+    )
+    result.update({
+        "backend": backend,
+        "reference_round": ref[0] if ref else None,
+        "batch_size": args.batch_size,
+        "machine": fp,
+    })
+    if result["ok"]:
+        # Ratchet: remember the best this machine has ever shown.
+        save_baseline(backend, fp, max(fresh, baseline or 0.0))
+    print(json.dumps({"bench_gate": result}), flush=True)
+    if not result["ok"]:
+        print(
+            f"BENCH_GATE FAIL: {result['fresh_samples_per_sec']} samples/s "
+            f"is >{args.threshold * 100:.0f}% below this machine's baseline "
+            f"{result['local_baseline']} samples/s",
+            flush=True,
+        )
+        return 1
+    print(
+        f"BENCH_GATE OK ({result['decided_by']}): "
+        f"{result['fresh_samples_per_sec']} samples/s",
+        flush=True,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,9 +3,13 @@
 # committed wrapper so the builder and the reviewer run the identical
 # command (pipefail, CPU pinned, fast lane only, DOTS_PASSED count) —
 # plus a fault-injection smoke leg (scripts/chaos_smoke.py) covering the
-# resilience layer's env-var plumbing end to end, and a telemetry smoke
+# resilience layer's env-var plumbing end to end, a telemetry smoke
 # leg (scripts/telemetry_smoke.py) covering the observability spine
-# (registry gauges, Prometheus exposition, spans, flight dumps).
+# (registry gauges, Prometheus exposition, spans, flight dumps, cluster
+# aggregation, run report, comm-bytes accounting), and a bench
+# regression gate (scripts/bench_gate.py) that fails on >10% samples/s
+# regression vs the committed BENCH trajectory / this machine's
+# calibrated baseline.
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -26,7 +30,12 @@ echo "# telemetry smoke leg"
 timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
 telemetry_rc=$?
 [ $telemetry_rc -ne 0 ] && echo "# telemetry smoke FAILED (rc=$telemetry_rc)"
+echo "# bench regression gate"
+timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+gate_rc=$?
+[ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 [ $rc -eq 0 ] && rc=$smoke_rc
 [ $rc -eq 0 ] && rc=$telemetry_rc
+[ $rc -eq 0 ] && rc=$gate_rc
 exit $rc

@@ -15,6 +15,11 @@ test hooks:
    lines.
 3. The span buffer holds the run's ``data_load`` / ``h2d`` /
    ``ckpt_write`` spans and saves a loadable Perfetto trace.
+4. The distributed-observability leg, single-process degenerate case:
+   the trainer's cluster aggregation published ``cluster_*{host=0}``
+   series and a ``run_report.json``/``.md`` pair, and a sharded dryrun
+   step (``shard_map`` + explicit collectives over a 2-virtual-device
+   mesh) left ``comm_bytes_total{op=...}`` gauges behind.
 
 Exits non-zero (with a reason) on any violation.
 """
@@ -25,6 +30,13 @@ import sys
 import tempfile
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Two virtual CPU devices so the comm-bytes leg has a real axis to
+# collect over (the trainer legs keep their single-device mesh).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -102,12 +114,56 @@ def main() -> int:
     if not loaded.get("traceEvents"):
         return fail("saved Perfetto trace is empty")
 
+    # 4. Distributed observability, degenerate single-host case.
+    for key in ("cluster_last_step{host=0}", "cluster_step_ms_p50{host=0}",
+                "cluster_syncs_total"):
+        if key not in default_registry().snapshot():
+            return fail(f"cluster aggregation missing {key!r}")
+    report_path = os.path.join(workdir, "run_report.json")
+    if not os.path.exists(report_path):
+        return fail("trainer did not write run_report.json")
+    report = json.load(open(report_path))
+    for section in ("throughput", "hosts", "comm_bytes_by_op", "resilience"):
+        if section not in report:
+            return fail(f"run report missing section {section!r}")
+    if report["resilience"].get("rollbacks") != 1:
+        return fail(f"run report missed the rollback: {report['resilience']}")
+    if not os.path.exists(os.path.join(workdir, "run_report.md")):
+        return fail("run_report.md missing")
+
+    # Comm-bytes gauges after one sharded (shard_map + explicit
+    # collective) step over the 2-virtual-device mesh.
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ml_trainer_tpu.parallel import create_mesh
+    from ml_trainer_tpu.parallel.collectives import psum
+    from ml_trainer_tpu.parallel.compat import shard_map
+
+    if jax.device_count() < 2:
+        return fail(f"expected 2 virtual devices, got {jax.device_count()}")
+    mesh = create_mesh({"data": 2}, devices=jax.devices()[:2])
+    step = jax.jit(shard_map(
+        lambda x: psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(),
+    ))
+    step(jnp.ones((4, 8), jnp.float32)).block_until_ready()
+    snap = default_registry().snapshot()
+    comm = snap.get("comm_bytes_total{op=psum}", 0)
+    # per-shard (2, 8) f32 = 64 bytes; ring all-reduce over 2 devices
+    # moves 2 * 64 * 1/2 = 64 bytes per participant.
+    if comm < 64:
+        return fail(f"comm_bytes_total{{op=psum}} not published: {comm}")
+
     print(
         "TELEMETRY_SMOKE OK: "
         f"{int(snap['train_steps_total'])} steps telemetered, "
         f"flight dump {dumps[0]} names step 3, "
         f"{len(loaded['traceEvents'])} trace events, "
-        f"{len(lines)} JSONL records"
+        f"{len(lines)} JSONL records, "
+        f"cluster series + run report present, "
+        f"psum comm bytes {int(comm)}"
     )
     return 0
 

@@ -30,6 +30,9 @@ os.environ["XLA_FLAGS"] = (
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Cross-process CPU computations need the gloo collectives backend (see
+# mp_worker.py); must be set before the first device use.
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
 jax.distributed.initialize(
     coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
 )

@@ -72,9 +72,18 @@ def _run_two_workers(worker, tmp_path, markers):
 
 @pytest.mark.slow
 def test_two_process_training_resume_and_desync(tmp_path):
+    """The full multi-host loop, now including distributed observability:
+    CLUSTER_AGG_OK pins that every host's registry carries BOTH hosts'
+    ``cluster_*{host=...}`` heartbeat series after training (so host 0's
+    scrape covers the pod), STRAGGLER_OK that a forced-slow host trips
+    the straggler counter + flight event naming it, and
+    DESYNC_FORENSICS_OK that the forced-desync negative case leaves a
+    registry fingerprint on every host plus a flight record AND an
+    on-disk dump naming the diverging host and step."""
     _run_two_workers(
         _WORKER, tmp_path,
-        ("LOSSES", "DESYNC_CLEAN_OK", "RESUME_OK", "DESYNC_FORCED_OK",
+        ("LOSSES", "DESYNC_CLEAN_OK", "CLUSTER_AGG_OK", "STRAGGLER_OK",
+         "RESUME_OK", "DESYNC_FORCED_OK", "DESYNC_FORENSICS_OK",
          "WORKER_DONE"),
     )
 

@@ -15,7 +15,13 @@ The contracts worth pinning:
   (test-pinned cache size), and the trajectory is bit-identical;
 * StepTimer: per-step percentiles (fenced, warmup-excluded);
 * history.json: JSON-safe mirror written next to the pickle,
-  preferred by ``load_history``.
+  preferred by ``load_history``;
+* distributed observability (telemetry/cluster.py): single-host
+  degenerate aggregation, straggler detection over an injected pod
+  matrix, trace-time collective-comms byte accounting, the run-report
+  emission at fit() end, the serving spec-acceptance histogram's real
+  Prometheus exposition, and the ``desync_every_steps`` knob (the real
+  2-process paths live in tests/test_multiprocess.py).
 """
 
 import json
@@ -304,6 +310,185 @@ def test_history_json_mirror_and_preference(tmp_path):
     # Without the mirror, the pickle still loads (the reference path).
     os.remove(jpath)
     assert load_history(d)["train_loss"] == hist["train_loss"]
+
+
+# ------------------------------------------------- distributed observability
+def test_cluster_single_host_aggregation_and_report(tmp_path):
+    """Degenerate one-host 'pod': heartbeat -> sync publishes
+    cluster_*{host=0} without any collective, no straggler can fire, and
+    the run report distills the registry into json + markdown."""
+    from ml_trainer_tpu.telemetry import (
+        ClusterTelemetry,
+        HEARTBEAT_FIELDS,
+        write_run_report,
+    )
+
+    r = MetricsRegistry()
+    fr = FlightRecorder()
+    ct = ClusterTelemetry(registry=r, flight=fr)
+    ct.heartbeat(last_step=10, step_ms_p50=4.0, step_ms_p99=9.0,
+                 samples_per_sec=1200.0)
+    gathered = ct.sync(step=10)
+    assert gathered.shape == (1, len(HEARTBEAT_FIELDS))
+    snap = r.snapshot()
+    assert snap["cluster_last_step{host=0}"] == 10.0
+    assert snap["cluster_step_ms_p50{host=0}"] == 4.0
+    assert snap["cluster_hosts"] == 1
+    # One host: nothing to straggle behind.
+    assert not any(
+        k.startswith("cluster_straggler_events_total") for k in snap
+    )
+    report = write_run_report(
+        str(tmp_path), history={"skipped_steps": [0], "rollbacks": 0},
+        registry=r, flight=fr,
+    )
+    payload = json.load(open(tmp_path / "run_report.json"))
+    assert payload["hosts"]["0"]["step_ms_p50"] == 4.0
+    assert payload["resilience"]["rollbacks"] == 0
+    md = open(tmp_path / "run_report.md").read()
+    assert "Per-host heartbeat" in md and "Resilience ledger" in md
+    assert report["paths"]["json"].endswith("run_report.json")
+
+    with pytest.raises(ValueError, match="straggler_factor"):
+        ClusterTelemetry(registry=r, straggler_factor=1.0)
+    with pytest.raises(ValueError, match="unknown heartbeat"):
+        ct.heartbeat(nonsense=1.0)
+
+
+def test_cluster_straggler_detector_on_injected_pod():
+    """A fabricated 2-host heartbeat matrix with one slow host must fire
+    the counter + flight event naming that host; symmetric times must
+    not.  The lower-median rule: on 2 hosts the slow one is compared
+    against the FAST one."""
+    import numpy as np
+
+    from ml_trainer_tpu.telemetry import ClusterTelemetry, HEARTBEAT_FIELDS
+
+    r = MetricsRegistry()
+    fr = FlightRecorder()
+    ct = ClusterTelemetry(registry=r, flight=fr, straggler_factor=2.0)
+    f = len(HEARTBEAT_FIELDS)
+    i50 = HEARTBEAT_FIELDS.index("step_ms_p50")
+    even = np.zeros((2, f))
+    even[:, i50] = (10.0, 11.0)
+    ct._ingest(even, step=5)
+    assert not any(
+        k.startswith("cluster_straggler_events_total")
+        for k in r.snapshot()
+    )
+    skewed = np.zeros((2, f))
+    skewed[:, i50] = (10.0, 25.0)  # 2.5x the fast host
+    ct._ingest(skewed, step=7)
+    snap = r.snapshot()
+    assert snap["cluster_straggler_events_total{host=1}"] == 1
+    ev = [rec for rec in fr.records() if rec["kind"] == "straggler"]
+    assert ev and ev[-1]["host"] == 1 and ev[-1]["step"] == 7
+    assert ev[-1]["cluster_median_ms"] == 10.0
+    # Hosts with no data (step_ms 0) neither straggle nor skew the median.
+    sparse = np.zeros((2, f))
+    sparse[0, i50] = 10.0
+    ct._ingest(sparse, step=9)
+    assert r.snapshot()["cluster_straggler_events_total{host=1}"] == 1
+
+
+def test_comm_accounting_formulas_and_traced_bytes():
+    """The analytic per-op byte formulas, and the trace-time recording
+    through a real shard_map collective on the simulated mesh: zero
+    runtime machinery, the gauges carry the analytic number."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ml_trainer_tpu.parallel import create_mesh
+    from ml_trainer_tpu.parallel.collectives import psum
+    from ml_trainer_tpu.parallel.comm_stats import (
+        collective_bytes,
+        comm_bytes,
+        comm_calls,
+        reset_comm_stats,
+    )
+    from ml_trainer_tpu.parallel.compat import shard_map
+
+    # Formula pins (size=1024 bytes, n=4).
+    assert collective_bytes("psum", 1024, 4) == 2 * 1024 * 3 / 4
+    assert collective_bytes("all_gather", 1024, 4) == 1024 * 3
+    assert collective_bytes("reduce_scatter", 1024, 4) == 1024 * 3 / 4
+    assert collective_bytes("ppermute", 1024, 4) == 1024
+    assert collective_bytes("all_to_all", 1024, 4) == 1024 * 3 / 4
+    assert collective_bytes("psum", 1024, 1) == 0.0  # no peers, no bytes
+    with pytest.raises(ValueError, match="unknown collective"):
+        collective_bytes("gossip", 1, 2)
+
+    reset_comm_stats()
+    mesh = create_mesh({"data": 4}, devices=jax.devices()[:4])
+    step = jax.jit(shard_map(
+        lambda x: psum(x, "data"), mesh=mesh,
+        in_specs=P("data"), out_specs=P(),
+    ))
+    step(jnp.ones((8, 4), jnp.float32)).block_until_ready()
+    # Per-shard input is (2, 4) f32 = 32 bytes -> ring all-reduce 48.
+    assert comm_bytes() == {"psum": 48.0}
+    assert comm_calls() == {"psum": 1}
+    from ml_trainer_tpu.telemetry import default_registry
+
+    assert default_registry().snapshot()[
+        "comm_bytes_total{op=psum}"
+    ] == 48.0
+    reset_comm_stats()
+    assert comm_bytes() == {}
+    assert default_registry().snapshot()[
+        "comm_bytes_total{op=psum}"
+    ] == 0.0
+
+
+def test_trainer_writes_run_report_and_desync_knob(tmp_path):
+    """fit() with telemetry ends by writing run_report.json/.md (the
+    degenerate single-host aggregation included); the desync knobs
+    validate and are harmless no-ops single-process."""
+    with pytest.raises(ValueError, match="desync_every_steps"):
+        make_trainer(tmp_path / "bad", desync_every_steps=0)
+    with pytest.raises(ValueError, match="straggler_factor"):
+        make_trainer(tmp_path / "bad2", straggler_factor=1.0)
+    t = make_trainer(
+        tmp_path / "m", telemetry=True, desync_every_steps=2,
+    )
+    t.fit()
+    payload = json.load(open(tmp_path / "m" / "run_report.json"))
+    assert payload["reason"] == "completed"
+    assert payload["hosts"]["0"]["last_step"] == t.steps_per_epoch
+    assert payload["resilience"]["rollbacks"] == 0
+    assert "checkpoint_writes" in payload
+    assert os.path.exists(tmp_path / "m" / "run_report.md")
+    from ml_trainer_tpu.telemetry import default_registry
+
+    snap = default_registry().snapshot()
+    assert snap["cluster_hosts"] == 1
+    assert snap["cluster_syncs_total"] >= 1
+
+
+def test_serving_spec_histogram_real_exposition():
+    """The spec acceptance distribution publishes as the registry's REAL
+    Histogram (cumulative le-buckets, histogram_quantile-able), and
+    repeated publishes observe only deltas — no double counting."""
+    from ml_trainer_tpu.serving.metrics import ServingMetrics
+
+    m = ServingMetrics()
+    m.record_spec([0, 2, 4], draft_k=4)
+    r = MetricsRegistry()
+    m.publish(r)
+    h = r.snapshot()
+    assert h["serving_spec_accept_count"] == 3
+    assert h["serving_spec_accept_sum"] == 6.0
+    m.publish(r)  # idempotent: same cumulative snapshot, no new samples
+    assert r.snapshot()["serving_spec_accept_count"] == 3
+    m.record_spec([4], draft_k=4)
+    m.publish(r)
+    assert r.snapshot()["serving_spec_accept_count"] == 4
+    text = prometheus_text(r)
+    assert "# TYPE serving_spec_accept histogram" in text
+    assert 'serving_spec_accept_bucket{le="0"} 1' in text
+    assert 'serving_spec_accept_bucket{le="+Inf"} 4' in text
+    # The JSON snapshot shape is unchanged (dashboards keep working).
+    assert m.snapshot()["spec_accept_hist"] == {"0": 1, "2": 1, "4": 2}
 
 
 # ------------------------------------------------------------------ flops
