@@ -395,6 +395,176 @@ def bench_serve(n_requests=32, mean_interarrival=0.01, max_batch=8,
     }
 
 
+def bench_serve_replay(n_requests=48, n_tenants=3, shared_frac=0.8,
+                       mean_interarrival=0.002, max_batch=8, seed=0,
+                       page_size=16, shared_len=160, out_path=None,
+                       spec_check=True):
+    """Multi-tenant ragged replay: PAGED engine (page pool + radix
+    prefix cache + tenant scheduler) vs the CONTIGUOUS engine on the
+    same trace.
+
+    The trace is production-shaped serving traffic: ``n_tenants``
+    tenants with Poisson arrivals, ``shared_frac`` of each tenant's
+    requests opening with that tenant's long shared prefix (system
+    prompt / few-shot preamble — ``shared_len`` tokens) followed by a
+    short unique suffix, the rest fully unique; ragged budgets with a
+    heavy tail.  Both engines replay the identical submissions
+    (prompt, budget, tenant, arrival time).
+
+    Method: each engine runs the trace TWICE and the second pass is
+    timed — pass 1 warms every compiled shape AND fills the prefix
+    cache to steady state, and the compiled-program count is asserted
+    constant across the timed pass (the zero-recompile pin).  Greedy
+    outputs are asserted byte-identical between the two engines, and
+    (``spec_check``) a spec_k mini-replay is asserted identical too.
+    Reports sustained tokens/s (useful generated tokens over makespan)
+    and TTFT p50/p99.  ``out_path`` writes the JSON artifact
+    (docs/serving_replay_cpu.json is the committed copy gated by
+    scripts/bench_gate.py).
+    """
+    from ml_trainer_tpu.generate import _COMPILED, generate
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import Server, TenantConfig
+
+    model = get_model("gpt2_tiny", max_len=256)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    rng = np.random.default_rng(seed)
+    tenants = {
+        f"tenant{t}": TenantConfig(weight=float(t + 1))
+        for t in range(n_tenants)
+    }
+    prefixes = [
+        rng.integers(0, model.vocab_size, shared_len).astype(np.int32)
+        for _ in range(n_tenants)
+    ]
+    trace = []
+    for i in range(n_requests):
+        t = int(rng.integers(0, n_tenants))
+        if rng.random() < shared_frac:
+            suffix = rng.integers(
+                0, model.vocab_size, int(rng.integers(4, 17))
+            ).astype(np.int32)
+            prompt = np.concatenate([prefixes[t], suffix])
+        else:
+            prompt = rng.integers(
+                0, model.vocab_size, int(rng.integers(16, 33))
+            ).astype(np.int32)
+        budget = int(rng.choice([4, 16], p=[0.75, 0.25]))
+        trace.append((prompt, budget, f"tenant{t}"))
+    arrivals = np.cumsum(rng.exponential(mean_interarrival, n_requests))
+    useful_tokens = sum(b for _, b, _ in trace)
+
+    def replay(server, timed: bool):
+        t0 = time.perf_counter()
+        streams = []
+        for i, (prompt, budget, tenant) in enumerate(trace):
+            wait = arrivals[i] - (time.perf_counter() - t0)
+            if wait > 0:
+                time.sleep(wait)
+            streams.append(server.submit(prompt, budget, tenant=tenant))
+        outs, ttfts = [], []
+        for s in streams:
+            outs.append(np.asarray(s.result(timeout=600)))
+            ttfts.append(s.request.first_token_at - s.request.submitted_at)
+        makespan = time.perf_counter() - t0
+        ttfts = np.sort(np.asarray(ttfts))
+        return {
+            "tokens_per_sec": round(useful_tokens / makespan, 1),
+            "ttft_p50_ms": round(float(ttfts[len(ttfts) // 2]) * 1e3, 1),
+            "ttft_p99_ms": round(
+                float(ttfts[min(len(ttfts) - 1,
+                                int(0.99 * (len(ttfts) - 1) + 0.5))]) * 1e3,
+                1,
+            ),
+            "makespan_s": round(makespan, 3),
+        }, outs
+
+    def run_engine(paged: bool):
+        kwargs = dict(max_batch=max_batch, max_queue=n_requests,
+                      tenants=dict(tenants))
+        if paged:
+            kwargs.update(kv_page_size=page_size)
+        with Server(model, variables, **kwargs) as srv:
+            replay(srv, timed=False)          # warm compiles + prefix cache
+            n_warm = len(_COMPILED._data)
+            stats, outs = replay(srv, timed=True)
+            n_after = len(_COMPILED._data)
+            snap = srv.metrics.snapshot()
+        stats["compiled_programs_constant"] = n_after == n_warm
+        stats["prefix_hit_rate"] = snap["prefix_hit_rate"]
+        stats["preemptions"] = snap["preemptions_total"]
+        return stats, outs
+
+    contig, contig_outs = run_engine(paged=False)
+    print(f"# serve replay contiguous: {contig['tokens_per_sec']:,.1f} "
+          f"tokens/s, TTFT p99 {contig['ttft_p99_ms']:,.1f} ms", flush=True)
+    paged, paged_outs = run_engine(paged=True)
+    print(f"# serve replay paged:      {paged['tokens_per_sec']:,.1f} "
+          f"tokens/s, TTFT p99 {paged['ttft_p99_ms']:,.1f} ms "
+          f"({paged['tokens_per_sec'] / contig['tokens_per_sec']:.2f}x, "
+          f"prefix hit rate {paged['prefix_hit_rate']:.2f})", flush=True)
+
+    identical = all(
+        np.array_equal(a, b) for a, b in zip(contig_outs, paged_outs)
+    )
+    spec_identical = None
+    if spec_check:
+        # Spec mini-replay: the fixed-K verify window reading through
+        # page tables must still be byte-identical to the contiguous
+        # spec path (and to generate()).
+        mini = trace[: min(6, len(trace))]
+        refs = [
+            np.asarray(generate(model, variables, p[None], b))[0]
+            for p, b, _ in mini
+        ]
+        spec_outs = {}
+        for paged_flag in (False, True):
+            kwargs = dict(max_batch=4, max_queue=len(mini), spec_k=4)
+            if paged_flag:
+                kwargs.update(kv_page_size=page_size)
+            with Server(model, variables, **kwargs) as srv:
+                ss = [srv.submit(p, b, tenant=t) for p, b, t in mini]
+                spec_outs[paged_flag] = [
+                    np.asarray(s.result(timeout=600)) for s in ss
+                ]
+        spec_identical = all(
+            np.array_equal(a, b) and np.array_equal(a, r)
+            for a, b, r in zip(spec_outs[False], spec_outs[True], refs)
+        )
+    result = {
+        "paged": paged,
+        "contiguous": contig,
+        "speedup": round(
+            paged["tokens_per_sec"] / contig["tokens_per_sec"], 3
+        ),
+        "ttft_p99_ratio": round(
+            paged["ttft_p99_ms"] / contig["ttft_p99_ms"], 3
+        ) if contig["ttft_p99_ms"] else None,
+        "greedy_byte_identical": identical,
+        "spec_byte_identical": spec_identical,
+        "n_requests": n_requests,
+        "n_tenants": n_tenants,
+        "shared_frac": shared_frac,
+        "shared_len": shared_len,
+        "page_size": page_size,
+        "max_batch": max_batch,
+        "useful_tokens": useful_tokens,
+        "backend": jax.default_backend(),
+    }
+    if not identical:
+        result["error"] = "paged output diverged from contiguous"
+    if spec_identical is False:
+        result["error"] = "spec paged output diverged"
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# serve replay artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_spec(b=2, pattern_len=8, prompt_len=64, new_tokens=128,
                draft_k=8, reps=2, seed=0):
     """Speculative-decoding leg: tokens/s of the speculative loop
@@ -1029,6 +1199,13 @@ def main():
                         "continuous-batching engine vs a generate_ragged "
                         "dynamic-batching baseline on ragged Poisson "
                         "arrivals (gpt2_tiny; CPU-safe)")
+    parser.add_argument("--serve-replay", action="store_true",
+                        help="run only the multi-tenant ragged replay: "
+                        "the PAGED engine (page pool + prefix cache + "
+                        "tenant scheduler) vs the contiguous engine on an "
+                        "80%%-shared-prefix Poisson trace; writes the "
+                        "docs/serving_replay_cpu.json artifact "
+                        "(gpt2_tiny; CPU-safe)")
     parser.add_argument("--assume-up", action="store_true",
                         help="skip the --one pre-probe (used by --extended, "
                         "whose parent just probed — a second throwaway "
@@ -1088,6 +1265,21 @@ def main():
         # Tiny model; meaningful on any backend.  One JSON line for the
         # driver, engine-vs-baseline, like the headline metric.
         print(json.dumps({"serve": bench_serve()}))
+        return
+    if args.serve_replay:
+        # Paged vs contiguous engine on the multi-tenant shared-prefix
+        # trace; the artifact is the acceptance evidence for the paged
+        # KV subsystem and feeds scripts/bench_gate.py.
+        import os as _os
+
+        out = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "serving_replay_cpu.json",
+        )
+        result = bench_serve_replay(out_path=out)
+        print(json.dumps({"serve_replay": result}))
+        if result.get("error"):
+            sys.exit(1)
         return
     if args.spec:
         # Speculative vs vanilla decode; tiny model, any backend.

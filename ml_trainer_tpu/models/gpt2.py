@@ -102,6 +102,10 @@ class GPT2(nn.Module):
     # activations' memory back).  Only read when remat=True.
     decode: bool = False  # KV-cached single-token inference (generate())
     loss_chunk: int = 0  # >0: with targets, chunked LM loss (see __call__)
+    # Paged KV serving (serving/kv_pool.py): the decode cache becomes a
+    # shared page pool + per-row page tables (models/layers.py).
+    kv_page_size: int = 0
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False, targets=None):
@@ -131,6 +135,7 @@ class GPT2(nn.Module):
                 moe_experts=self.moe_experts, moe_top_k=self.moe_top_k,
                 decode=self.decode,
                 decode_max_len=self.max_len if self.decode else 0,
+                kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
                 name=f"block{i}",
             )(x, None, train)
         return _tied_head(self, x, tok_embed, targets)

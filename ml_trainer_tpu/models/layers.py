@@ -46,6 +46,13 @@ class MultiHeadAttention(nn.Module):
     mesh: Optional[object] = None  # jax Mesh, required for 'ring'
     decode: bool = False
     decode_max_len: int = 0
+    # Paged KV cache (serving/kv_pool.py): > 0 switches the decode cache
+    # from per-row contiguous [B, H, L, D] blocks to a SHARED pool of
+    # fixed-size pages [kv_pages, H, kv_page_size, D] addressed through a
+    # per-row page table — rows own pages, not max_len regions, so pool
+    # memory tracks live tokens and identical prefixes can share pages.
+    kv_page_size: int = 0
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
@@ -92,6 +99,8 @@ class MultiHeadAttention(nn.Module):
         L = self.decode_max_len
         if L <= 0:
             raise ValueError("decode=True needs decode_max_len > 0")
+        if self.kv_page_size:
+            return self._paged_decode_step(q, k, v)
         cached_k = self.variable(
             "cache", "cached_key",
             lambda: jnp.zeros((b, h, L, d), self.dtype),
@@ -162,6 +171,95 @@ class MultiHeadAttention(nn.Module):
             causal=False, mask=valid, implementation="xla",
         )
 
+    def _paged_decode_step(self, q, k, v):
+        """Paged cached attention (serving/kv_pool.py's memory model).
+
+        K/V live in ONE pool of ``kv_pages`` fixed-size pages
+        ``[N, H, page, D]`` shared by every batch row; a per-row
+        ``page_table`` ``[B, P]`` (P = decode_max_len / page) maps each
+        row's logical position ``i`` to page ``table[row, i // page]``
+        at offset ``i % page``.  Writes scatter the length-``s`` window
+        at each row's own dynamic offset (the PR2 windowed-append
+        discipline: shapes static at fixed ``s``, so ragged join/leave
+        traffic and the speculative verify window never recompile);
+        reads gather ``pool[table]`` back into logical order
+        ``[B, H, P·page, D]`` and attend under the same
+        ``arange(L) <= idx + j`` validity mask as the contiguous slot
+        path — so a paged row computes bit-for-bit the same attention
+        as a contiguous row holding the same K/V.
+
+        Safety invariants (owned by the engine/pool, exploited here):
+        page 0 is a TRASH page no live row maps to; inactive rows carry
+        an all-zero table, so their writes (positions clipped into the
+        table) land in trash instead of another row's pages, and
+        positions past a row's allocation also resolve to trash.
+        """
+        b, h, s, d = q.shape
+        ps = self.kv_page_size
+        L = self.decode_max_len
+        if L % ps:
+            raise ValueError(
+                f"decode_max_len ({L}) must be a multiple of kv_page_size "
+                f"({ps}) — the gathered logical length must equal the "
+                "contiguous path's for byte-identical attention"
+            )
+        if self.kv_pages < 2:
+            raise ValueError(
+                f"kv_pages must be >= 2 (page 0 is the trash page), got "
+                f"{self.kv_pages}"
+            )
+        P = L // ps
+        pool_k = self.variable(
+            "cache", "cached_key",
+            lambda: jnp.zeros((self.kv_pages, h, ps, d), self.dtype),
+        )
+        pool_v = self.variable(
+            "cache", "cached_value",
+            lambda: jnp.zeros((self.kv_pages, h, ps, d), self.dtype),
+        )
+        table_var = self.variable(
+            "cache", "page_table", lambda: jnp.zeros((b, P), jnp.int32)
+        )
+        idx_var = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = idx_var.value
+        # Init trace reaches here with the scalar init value; broadcast
+        # for the (garbage) init compute, keep the stored shape intact.
+        idx_vec = idx if idx.ndim == 1 else jnp.full((b,), idx, jnp.int32)
+        table = table_var.value
+
+        # -- write: scatter the window at each row's own offset ----------
+        positions = idx_vec[:, None] + jnp.arange(s)[None, :]       # [B, s]
+        page_slot = jnp.clip(positions // ps, 0, P - 1)
+        offs = positions % ps
+        page_ids = jnp.take_along_axis(table, page_slot, axis=1)    # [B, s]
+
+        def scatter(pool, t):  # t: [B, H, s, D] -> rows [B*s, H, D]
+            rows = t.astype(pool.dtype).transpose(0, 2, 1, 3)
+            rows = rows.reshape(b * s, h, d)
+            return pool.at[
+                page_ids.reshape(-1), :, offs.reshape(-1), :
+            ].set(rows)
+
+        pool_k.value = scatter(pool_k.value, k)
+        pool_v.value = scatter(pool_v.value, v)
+        idx_var.value = idx + s
+
+        # -- read: gather pages back into logical order ------------------
+        def gather(pool):  # [B, P, H, page, D] -> [B, H, L, D]
+            g = pool[table]
+            return g.transpose(0, 2, 1, 3, 4).reshape(b, h, P * ps, d)
+
+        valid = (
+            jnp.arange(L)[None, None, :]
+            <= idx_vec[:, None, None] + jnp.arange(s)[None, :, None]
+        )[:, None, :, :]
+        return attention(
+            q, gather(pool_k.value), gather(pool_v.value),
+            causal=False, mask=valid, implementation="xla",
+        )
+
 
 class MLP(nn.Module):
     """Transformer feed-forward block."""
@@ -229,6 +327,8 @@ class TransformerBlock(nn.Module):
     moe_top_k: int = 1    # experts per token (1 = Switch, 2 = GShard)
     decode: bool = False  # KV-cached single-token mode (see MultiHeadAttention)
     decode_max_len: int = 0
+    kv_page_size: int = 0  # >0: paged KV pool (see MultiHeadAttention)
+    kv_pages: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
@@ -236,7 +336,9 @@ class TransformerBlock(nn.Module):
             self.num_heads, causal=self.causal, dropout_rate=self.dropout_rate,
             dtype=self.dtype, attention_impl=self.attention_impl,
             mesh=self.mesh, decode=self.decode,
-            decode_max_len=self.decode_max_len, name="attn",
+            decode_max_len=self.decode_max_len,
+            kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
+            name="attn",
         )(y, mask=mask, train=train, kv_lens=kv_lens)
         if self.moe_experts:
             from ml_trainer_tpu.models.moe import MoEMLP

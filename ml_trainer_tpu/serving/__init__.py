@@ -1,25 +1,33 @@
 """Continuous-batching serving engine (docs/serving.md).
 
-One preallocated slot cache, one compiled per-token decode step;
-requests join and leave at token boundaries with no recompilation.
+One compiled per-token decode step over a slot cache; requests join and
+leave at token boundaries with no recompilation.  Opt into the paged KV
+cache + radix prefix cache + multi-tenant scheduler with
+``kv_page_size``/``tenants``:
 
-    from ml_trainer_tpu.serving import Server
+    from ml_trainer_tpu.serving import Server, TenantConfig
 
-    server = Server(model, variables, max_batch=8)
-    stream = server.submit(prompt_ids, max_new_tokens=64)
+    server = Server(model, variables, max_batch=8,
+                    kv_page_size=16,              # paged KV + prefix cache
+                    tenants={"pro": TenantConfig(weight=3.0)})
+    stream = server.submit(prompt_ids, max_new_tokens=64, tenant="pro")
     for token in stream: ...          # streamed
     full = server.complete(prompt_ids, 64)   # blocking
 """
 
 from ml_trainer_tpu.serving.api import Server, TokenStream
 from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+from ml_trainer_tpu.serving.kv_pool import KVPagePool
 from ml_trainer_tpu.serving.metrics import ServingMetrics
+from ml_trainer_tpu.serving.prefix_cache import PrefixCache
 from ml_trainer_tpu.serving.scheduler import (
     AdmissionError,
     DeadlineExceeded,
     EngineUnhealthy,
     FifoScheduler,
     Request,
+    TenantConfig,
+    TenantScheduler,
 )
 
 __all__ = [
@@ -27,7 +35,11 @@ __all__ = [
     "TokenStream",
     "SlotDecodeEngine",
     "ServingMetrics",
+    "KVPagePool",
+    "PrefixCache",
     "FifoScheduler",
+    "TenantScheduler",
+    "TenantConfig",
     "Request",
     "AdmissionError",
     "DeadlineExceeded",

@@ -11,7 +11,8 @@ driving the admit/step loop.  Callers interact through:
   (``http.server``; no dependencies), started only when asked for
   (constructor flag ``http_port`` or an explicit call): POST
   ``/v1/generate`` with ``{"prompt": [ids...], "max_new_tokens": n,
-  "temperature": t?, "seed": s?, "eos_token_id": e?, "deadline": d?}``
+  "temperature": t?, "seed": s?, "eos_token_id": e?, "deadline": d?,
+  "tenant": name?, "priority": p?}``
   returns ``{"tokens": [...]}``; GET ``/metrics`` serves Prometheus
   text exposition of the process telemetry registry (serving gauges
   freshly published — what a scraper points at); GET ``/metrics.json``
@@ -45,8 +46,8 @@ from ml_trainer_tpu.serving.scheduler import (
     AdmissionError,
     DeadlineExceeded,
     EngineUnhealthy,
-    FifoScheduler,
     Request,
+    TenantScheduler,
     _DONE,
 )
 from ml_trainer_tpu.utils.logging import get_logger
@@ -131,21 +132,41 @@ class Server:
                  http_port: Optional[int] = None,
                  spec_k: int = 0, drafter="ngram",
                  draft_variables: Optional[dict] = None,
-                 watchdog_timeout: Optional[float] = 60.0):
+                 watchdog_timeout: Optional[float] = 60.0,
+                 kv_page_size: int = 0, kv_pages: int = 0,
+                 prefix_cache: bool = True,
+                 tenants: Optional[dict] = None,
+                 max_preemptions: int = 8):
         """``watchdog_timeout``: seconds the engine loop may go without a
         heartbeat WHILE work is pending before the watchdog declares it
         wedged — fails every in-flight/queued request with a structured
         error, marks the server unhealthy and stops admission.  Size it
         well above the slowest single decode/prefill dispatch (first-hit
         XLA compiles run on this thread).  ``None`` disables the
-        watchdog."""
+        watchdog.
+
+        ``kv_page_size > 0`` switches the engine to the PAGED KV cache
+        (docs/serving.md): K/V lives in ``kv_pages`` fixed-size pages
+        (0 = full contiguous capacity, i.e. no oversubscription) behind
+        per-slot page tables; ``prefix_cache`` enables the radix prefix
+        cache so shared prompt prefixes skip prefill; under page
+        pressure long generations are preempted and re-queued (at most
+        ``max_preemptions`` times each) with their generated tokens as
+        a resumable prefix.
+
+        ``tenants`` maps tenant name -> :class:`TenantConfig` (weight,
+        max_active, max_queued); requests name their tenant at
+        ``submit``.  Unknown tenants get the default config."""
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.engine = SlotDecodeEngine(
             model, variables, max_batch=max_batch, metrics=self.metrics,
             spec_k=spec_k, drafter=drafter, draft_variables=draft_variables,
+            kv_page_size=kv_page_size, kv_pages=kv_pages,
+            prefix_cache=prefix_cache, max_preemptions=max_preemptions,
         )
-        self.scheduler = FifoScheduler(
-            max_batch, max_queue=max_queue, metrics=self.metrics
+        self.scheduler = TenantScheduler(
+            max_batch, max_queue=max_queue, metrics=self.metrics,
+            tenants=tenants,
         )
         self._idle_poll = idle_poll
         self._log = get_logger("ml_trainer_tpu.serving")
@@ -183,11 +204,14 @@ class Server:
     def submit(self, prompt, max_new_tokens: int,
                temperature: float = 0.0, rng=None,
                eos_token_id: Optional[int] = None,
-               deadline: Optional[float] = None) -> TokenStream:
+               deadline: Optional[float] = None,
+               tenant: str = "default", priority: int = 0) -> TokenStream:
         """Enqueue one request (thread-safe).  Raises ``AdmissionError``
-        when the queue is at its watermark (or the server is draining),
-        ``EngineUnhealthy`` when the engine is wedged/dead, and
-        ``ValueError`` on a request the engine could never serve."""
+        when the queue (global or the tenant's) is at its watermark (or
+        the server is draining), ``EngineUnhealthy`` when the engine is
+        wedged/dead, and ``ValueError`` on a request the engine could
+        never serve.  ``tenant``/``priority`` feed the multi-tenant
+        scheduler (higher priority admits first within a tenant)."""
         if self._stopping:
             raise RuntimeError("server is closed")
         if not self.healthy:
@@ -225,10 +249,14 @@ class Server:
                 f"eos_token_id must be in [0, {self.engine.vocab_size}), "
                 f"got {eos_token_id}"
             )
+        if not isinstance(tenant, str) or not tenant:
+            raise ValueError(f"tenant must be a non-empty string, got "
+                             f"{tenant!r}")
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), rng=rng,
             eos_token_id=eos_token_id, deadline=deadline,
+            tenant=tenant, priority=int(priority),
         )
         self.scheduler.submit(req)
         self._wake.set()
@@ -306,6 +334,7 @@ class Server:
             if release_slots:
                 self._admitting_req = None
                 if admitting.slot >= 0:
+                    engine._release_slot_pages(admitting.slot, donate=False)
                     try:
                         sched.release(admitting.slot)
                     except ValueError:
@@ -315,11 +344,14 @@ class Server:
                 req.finish("error", msg)
             if release_slots:
                 engine._active.pop(slot, None)
+                engine._release_slot_pages(slot, donate=False)
                 try:
                     sched.release(slot)
                 except ValueError:
                     pass
         for req in sched.drain_pending():
+            req.finish("error", msg)
+        for req in engine.drain_preempted():
             req.finish("error", msg)
 
     def _mark_unhealthy(self, reason: str) -> None:
@@ -406,13 +438,25 @@ class Server:
                     # is still visible to the watchdog/error handler and
                     # failed with the rest instead of hanging its stream.
                     self._admitting_req = req
-                    if not engine.admit(req, slot):
-                        sched.release(slot)
+                    status = engine.admit(req, slot)
                     self._admitting_req = None
                     progressed = True
+                    if status == "no_memory":
+                        # Page pressure: hand the slot back, re-queue the
+                        # request at the head of its tenant queue, and let
+                        # the running requests free pages first.
+                        sched.release(slot)
+                        sched.requeue(req)
+                        break
+                    if status == "finished":
+                        sched.release(slot)
                 if engine.active_count():
                     for slot in engine.step():
                         sched.release(slot)
+                    # Preempt-and-requeue victims resume from their
+                    # committed tokens (head of their tenant queue).
+                    for req in engine.drain_preempted():
+                        sched.requeue(req)
                     progressed = True
                 if not progressed:
                     self._wake.wait(timeout=self._idle_poll)
@@ -429,6 +473,9 @@ class Server:
                     # the sweep below would miss it.
                     admitting.finish("error", err)
                     if admitting.slot >= 0:
+                        engine._release_slot_pages(
+                            admitting.slot, donate=False
+                        )
                         try:
                             sched.release(admitting.slot)
                         except ValueError:
@@ -437,10 +484,13 @@ class Server:
                     if req.state == "active":
                         req.finish("error", err)
                     del engine._active[slot]
+                    engine._release_slot_pages(slot, donate=False)
                     try:
                         sched.release(slot)
                     except ValueError:
                         pass
+                for req in engine.drain_preempted():
+                    req.finish("error", err)
 
     # -- HTTP front end --------------------------------------------------
 
@@ -530,6 +580,8 @@ class Server:
                         rng=body.get("seed"),
                         eos_token_id=body.get("eos_token_id"),
                         deadline=body.get("deadline"),
+                        tenant=str(body.get("tenant", "default")),
+                        priority=int(body.get("priority", 0)),
                     )
                     self._send(200, {"tokens": [int(t) for t in out]})
                 except AdmissionError as e:

@@ -47,6 +47,29 @@ bound covers every decode executable in the process.
   tokens per slot — still one static-shaped executable at fixed K, so
   join/leave semantics and the no-recompilation guarantee carry over
   unchanged.  Greedy slots stay byte-identical to ``generate()``.
+
+* **Paged mode** (``kv_page_size > 0``, see kv_pool.py,
+  prefix_cache.py and docs/serving.md): the per-slot contiguous
+  ``[max_batch, H, max_len, D]`` regions become ONE pool of fixed-size
+  pages addressed through host-owned per-slot page tables
+  (models/layers.py's paged gather/scatter path — still one static
+  executable, the table is an ordinary input).  What that buys:
+
+  - memory tracks LIVE tokens, not ``max_batch × max_len`` worst case;
+  - a radix prefix cache maps shared prompt prefixes to already-filled
+    refcounted pages, so a prefix hit skips their prefill entirely —
+    only the unshared suffix runs (a ``serve_prefill_paged``
+    continuation window at the slot's dynamic offset);
+  - under page pressure the engine evicts cold prefix pages first, then
+    PREEMPTS a victim request: its written pages are donated to the
+    prefix cache, the rest freed, and the request re-queues with its
+    generated tokens as a resumable prefix (flight-recorder ``preempt``
+    event; a structured client error after ``max_preemptions``).
+
+  Requests with no prefix hit still prefill through the SAME contiguous
+  batch-1 program as the contiguous engine and are scatter-inserted
+  into their pages bit-for-bit, which is what keeps greedy and
+  speculative output byte-identical to the contiguous path.
 """
 
 from __future__ import annotations
@@ -59,7 +82,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, _empty_cache
+from ml_trainer_tpu.serving.kv_pool import KVPagePool
 from ml_trainer_tpu.serving.metrics import ServingMetrics
+from ml_trainer_tpu.serving.prefix_cache import PrefixCache
 from ml_trainer_tpu.serving.scheduler import Request
 from ml_trainer_tpu.telemetry.flight import get_recorder
 from ml_trainer_tpu.telemetry.spans import StepProfiler, span
@@ -97,16 +122,24 @@ def _sample_rows(last, temps, rngs, steps):
     return jnp.where(temps > 0, sampled, greedy_tok)
 
 
+def _leaf_name(path) -> Optional[str]:
+    """Last dict key of a tree path (None for non-dict paths)."""
+    return getattr(path[-1], "key", None) if path else None
+
+
 class SlotDecodeEngine:
-    """The slot cache plus its three compiled programs.  Single-threaded
-    by design: one worker (serving/api.py's loop) calls ``admit`` and
+    """The slot cache plus its compiled programs.  Single-threaded by
+    design: one worker (serving/api.py's loop) calls ``admit`` and
     ``step``; thread-safe admission lives in the scheduler."""
 
     def __init__(self, model, variables: dict, max_batch: int = 8,
                  metrics: Optional[ServingMetrics] = None,
                  spec_k: int = 0, drafter="ngram",
                  draft_variables: Optional[dict] = None,
-                 ngram_n: int = 3):
+                 ngram_n: int = 3,
+                 kv_page_size: int = 0, kv_pages: int = 0,
+                 prefix_cache: bool = True,
+                 max_preemptions: int = 8):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if not getattr(model, "max_len", 0):
@@ -120,18 +153,58 @@ class SlotDecodeEngine:
                 f"got {spec_k}"
             )
         self.model = model
-        self.dm = model.clone(decode=True)
-        self.params = (
-            variables["params"] if "params" in variables else variables
-        )
         self.max_batch = max_batch
         self.max_len = int(model.max_len)
         self.vocab_size = int(model.vocab_size)
         self.metrics = metrics if metrics is not None else ServingMetrics()
 
+        # -- paged KV mode (opt-in) -------------------------------------
+        self.kv_page_size = int(kv_page_size)
+        self.paged = self.kv_page_size > 0
+        self.pool: Optional[KVPagePool] = None
+        self._prefix: Optional[PrefixCache] = None
+        self.max_preemptions = int(max_preemptions)
+        self._preempted: List[Request] = []
+        if self.paged:
+            if self.max_len % self.kv_page_size:
+                raise ValueError(
+                    f"kv_page_size ({kv_page_size}) must divide max_len "
+                    f"({self.max_len})"
+                )
+            pages_per_slot = self.max_len // self.kv_page_size
+            # Default pool: full contiguous capacity + the trash page —
+            # no oversubscription until the caller asks for it.
+            self.kv_pages = int(kv_pages) or max_batch * pages_per_slot + 1
+            self.pool = KVPagePool(
+                self.kv_pages, self.kv_page_size, self.max_len, max_batch
+            )
+            if prefix_cache:
+                self._prefix = PrefixCache(self.pool)
+            # The model whose decode cache is paged: compiled decode /
+            # verify / continuation programs key on THIS clone, so a
+            # paged and a contiguous engine in one process never collide
+            # in the compile cache.
+            self._key_model = model.clone(
+                kv_page_size=self.kv_page_size, kv_pages=self.kv_pages
+            )
+        else:
+            if kv_pages:
+                raise ValueError("kv_pages needs kv_page_size > 0")
+            self.kv_pages = 0
+            self._key_model = model
+        self.dm = self._key_model.clone(decode=True)
+        # Prefill ALWAYS runs the contiguous batch-1 program (shared
+        # with contiguous engines — and the anchor that keeps paged
+        # output byte-identical): its cache is scatter-inserted into the
+        # pages afterwards.
+        self._dm_prefill = model.clone(decode=True)
+        self.params = (
+            variables["params"] if "params" in variables else variables
+        )
+
         # Batch-1 cache shapes for prefill; slot cache at max_batch with
         # the scalar index leaves widened to [max_batch] vectors.
-        self._shapes_b1 = _cache_shapes(self.dm, 1, jnp.int32)
+        self._shapes_b1 = _cache_shapes(self._dm_prefill, 1, jnp.int32)
         shapes_mb = _cache_shapes(self.dm, max_batch, jnp.int32)
         self.cache = jax.tree.map(
             lambda s: jnp.zeros(
@@ -152,11 +225,21 @@ class SlotDecodeEngine:
         self._profiler = StepProfiler("serve")
 
         self._decode = self._program(
-            ("serve_decode", model, max_batch), self._build_decode
+            ("serve_decode", self._key_model, max_batch), self._build_decode
         )
-        self._insert = self._program(
-            ("serve_insert", model, max_batch), self._build_insert
-        )
+        if self.paged:
+            self._insert = self._program(
+                ("serve_insert_paged", self._key_model, max_batch),
+                self._build_insert_paged,
+            )
+        else:
+            self._insert = self._program(
+                ("serve_insert", model, max_batch), self._build_insert
+            )
+        # Host mirror of each slot's consumed-token count (device
+        # ``cache_index``): spec mode always needs it for the verify
+        # window; paged mode needs it for page allocation.
+        self._pos = np.zeros((max_batch,), np.int32)
 
         # -- speculative decoding (opt-in; see speculative.py) ----------
         # Slots advance a variable 1..spec_k+1 tokens per verify step;
@@ -182,13 +265,13 @@ class SlotDecodeEngine:
                     f"registry model, got {drafter!r}"
                 )
             self._verify = self._program(
-                ("spec_verify", model, max_batch, self.spec_k + 1),
-                lambda: build_verify(model, max_batch, self.spec_k + 1),
+                ("spec_verify", self._key_model, max_batch, self.spec_k + 1),
+                lambda: build_verify(self._key_model, max_batch,
+                                     self.spec_k + 1),
             )
-            # Host-owned consumed-token counts and write caps per slot
-            # (the verify window writes spec_k+1 positions at pos, so
-            # pos is clamped to keep every write inside max_len).
-            self._pos = np.zeros((max_batch,), np.int32)
+            # Write caps per slot (the verify window writes spec_k+1
+            # positions at pos, so pos is clamped to keep every write
+            # inside max_len).
             self._caps = np.full(
                 (max_batch,), self.max_len - self.spec_k - 1, np.int32
             )
@@ -200,6 +283,9 @@ class SlotDecodeEngine:
                         f"draft model max_len ({d_model.max_len}) must "
                         f"cover the target's ({self.max_len})"
                     )
+                # The draft model keeps the CONTIGUOUS slot cache: it is
+                # sized tiny by design (gpt2_nano-class), so paging its
+                # K/V buys nothing and would double the page machinery.
                 self._draft_dm = d_model.clone(decode=True)
                 self._draft_shapes_b1 = _cache_shapes(
                     self._draft_dm, 1, jnp.int32
@@ -266,28 +352,273 @@ class SlotDecodeEngine:
 
         return jax.jit(insert, donate_argnums=(0, 1))
 
+    def _build_insert_paged(self):
+        """Scatter a contiguous batch-1 prefill cache into a slot's
+        pages: position ``j`` of the b1 cache lands in page
+        ``page_row[j // page_size]`` at offset ``j % page_size`` — a pure
+        data movement, so the paged slot holds bit-for-bit the K/V the
+        contiguous engine would.  ``page_row`` is the slot's full table
+        row (trash-0 past its chain, where the bucket's padding garbage
+        harmlessly lands)."""
+        ps, L = self.kv_page_size, self.max_len
+        from jax import tree_util
+
+        def insert(cache_big, tok_big, cache1, tok0, slot, true_len,
+                   page_row):
+            page_of_pos = jnp.repeat(page_row, ps)          # [L]
+            offs = jnp.arange(L) % ps
+            big_flat, treedef = tree_util.tree_flatten_with_path(cache_big)
+            small = {
+                tuple(getattr(k, "key", str(k)) for k in p): leaf
+                for p, leaf in tree_util.tree_flatten_with_path(cache1)[0]
+            }
+            out = []
+            for path, big in big_flat:
+                if _leaf_name(path) == "page_table":
+                    out.append(big.at[slot].set(page_row.astype(big.dtype)))
+                    continue
+                sm = small[tuple(getattr(k, "key", str(k)) for k in path)]
+                if big.ndim == 4:
+                    rows = sm[0].transpose(1, 0, 2).astype(big.dtype)  # [L,H,D]
+                    out.append(big.at[page_of_pos, :, offs, :].set(rows))
+                else:
+                    out.append(
+                        big.at[slot].set(jnp.asarray(true_len, big.dtype))
+                    )
+            cache_big = tree_util.tree_unflatten(treedef, out)
+            tok_big = jax.lax.dynamic_update_slice(
+                tok_big, tok0[:, None], (slot, 0)
+            )
+            return cache_big, tok_big
+
+        return jax.jit(insert, donate_argnums=(0, 1))
+
     def _build_prefill(self, bucket: int, dm=None, shapes=None):
-        dm = dm if dm is not None else self.dm
+        dm = dm if dm is not None else self._dm_prefill
         shapes = shapes if shapes is not None else self._shapes_b1
 
-        def prefill(params, prompt_pad, true_len, temp, rng):
+        def prefill(params, prompt_pad, true_len, temp, rng, step0):
             cache = _empty_cache(shapes)
             logits, mut = dm.apply(
                 {"params": params, "cache": cache}, prompt_pad,
                 train=False, mutable=["cache"],
             )
             # Causal prefill: the padded tail cannot influence position
-            # true_len-1, whose logits sample token 0 (fold counter 0 —
-            # generate()'s t=0 draw).
+            # true_len-1, whose logits sample token 0 (fold counter
+            # ``step0`` — 0 for fresh requests, the committed-token
+            # count for a preempt-resume, so the sampled stream
+            # continues generate()'s per-token fold sequence).
+            last = jax.lax.dynamic_index_in_dim(
+                logits, true_len - 1, axis=1, keepdims=False
+            )
+            tok = _sample_rows(last, temp[None], rng[None], step0[None])
+            return mut["cache"], tok.astype(jnp.int32)
+
+        return jax.jit(prefill)
+
+    def _build_prefill_paged(self, bucket: int):
+        """Continuation prefill for a PREFIX-CACHE hit: run only the
+        unshared suffix (padded to ``bucket``) through the paged decode
+        path at the slot's dynamic offset ``start`` — the suffix window
+        attends the shared pages like a verify window attends committed
+        tokens, writes its own K/V into the slot's fresh pages, and the
+        true last position's logits sample the first new token.  The
+        shared prefix's prefill is skipped entirely."""
+        dm = self.dm
+        from jax import tree_util
+
+        def run(cache_big, tok_big, params, window, true_len, start,
+                page_row, temp, rng, step0, slot):
+            big_flat, treedef = tree_util.tree_flatten_with_path(cache_big)
+            # Batch-1 view: shared pools as-is, this slot's table row and
+            # start offset as the [1]-row metadata.
+            view = []
+            for path, leaf in big_flat:
+                if leaf.ndim == 4:
+                    view.append(leaf)
+                elif _leaf_name(path) == "page_table":
+                    view.append(page_row[None, :])
+                else:
+                    view.append(jnp.full((1,), start, leaf.dtype))
+            cache1 = tree_util.tree_unflatten(treedef, view)
+            logits, mut = dm.apply(
+                {"params": params, "cache": cache1}, window,
+                train=False, mutable=["cache"],
+            )
             last = jax.lax.dynamic_index_in_dim(
                 logits, true_len - 1, axis=1, keepdims=False
             )
             tok = _sample_rows(
-                last, temp[None], rng[None], jnp.zeros((1,), jnp.int32)
+                last, temp[None], rng[None], step0[None]
+            ).astype(jnp.int32)
+            # Write back: pools carry the suffix K/V; slot metadata
+            # advances to the full consumed length.
+            mut_flat = tree_util.tree_flatten_with_path(mut["cache"])[0]
+            out = []
+            for (path, big), (_, new) in zip(big_flat, mut_flat):
+                if big.ndim == 4:
+                    out.append(new)
+                elif _leaf_name(path) == "page_table":
+                    out.append(big.at[slot].set(page_row.astype(big.dtype)))
+                else:
+                    out.append(
+                        big.at[slot].set((start + true_len).astype(big.dtype))
+                    )
+            cache_big = tree_util.tree_unflatten(treedef, out)
+            tok_big = jax.lax.dynamic_update_slice(
+                tok_big, tok[:, None], (slot, 0)
             )
-            return mut["cache"], tok.astype(jnp.int32)
+            return cache_big, tok_big, tok
 
-        return jax.jit(prefill)
+        return jax.jit(run, donate_argnums=(0, 1))
+
+    # -- paged memory management ----------------------------------------
+
+    def _sync_table(self) -> None:
+        """Upload the host page table into every layer's table leaf when
+        it changed (slot freed / pages appended): a compiled step must
+        never write through a stale device table into a recycled page.
+        Each leaf gets its OWN device copy — donation-safe."""
+        if not self.paged or not self.pool.dirty:
+            return
+        host = self.pool.page_table
+
+        def leaf(l):
+            if l.ndim == 2 and l.dtype == jnp.int32:
+                return jnp.asarray(host)
+            return l
+
+        self.cache = jax.tree.map(leaf, self.cache)
+        self.pool.dirty = False
+
+    def _page_row(self, slot: int) -> np.ndarray:
+        row = np.zeros((self.pool.pages_per_slot,), np.int32)
+        chain = self.pool.slot_pages[slot]
+        row[: len(chain)] = chain
+        return row
+
+    def _release_slot_pages(self, slot: int, req: Optional[Request] = None,
+                            donate: bool = True) -> None:
+        """Return a slot's pages to the pool (idempotent).  With
+        ``donate``, its WRITTEN full blocks are first registered in the
+        prefix cache — a finished request's prompt stays hot for the
+        next user, and a preempted victim can re-pin its own pages on
+        resume."""
+        if not self.paged:
+            return
+        chain = self.pool.slot_pages[slot]
+        if chain and donate and self._prefix is not None and req is not None:
+            blocks = int(self._pos[slot]) // self.kv_page_size
+            if blocks:
+                seq = np.concatenate([
+                    np.asarray(req.prompt, np.int32).reshape(-1),
+                    np.asarray(req.tokens, np.int32),
+                ])
+                self._prefix.insert(seq, chain[:blocks])
+        self.pool.reset_slot(slot)
+        self._push_kv_metrics()
+
+    def _push_kv_metrics(self) -> None:
+        if not self.paged:
+            return
+        self.metrics.record_kv(
+            self.pool.free_count(), self.pool.used_count(),
+            self.kv_pages - 1,
+            len(self._prefix) if self._prefix is not None else 0,
+        )
+        if self._prefix is not None:
+            self.metrics.record_prefix_stats(
+                self._prefix.hits, self._prefix.misses,
+                self._prefix.hit_tokens, self._prefix.lookup_tokens,
+            )
+
+    def _pick_victim(self, exclude: int) -> Optional[int]:
+        """Preemption victim: lowest priority first, youngest admission
+        within a priority (losing the least completed work)."""
+        candidates = [
+            (req.priority, -(req.admitted_at or 0.0), slot)
+            for slot, req in self._active.items()
+            if slot != exclude
+        ]
+        if not candidates:
+            return None
+        candidates.sort()
+        return candidates[0][2]
+
+    def _preempt(self, slot: int, cause: str) -> None:
+        """Evict ``slot``'s request under page pressure: donate its
+        written blocks to the prefix cache, free the rest, and re-queue
+        it (via ``drain_preempted``) with its generated tokens as a
+        resumable prefix — or fail it with a structured error once it
+        has been preempted ``max_preemptions`` times."""
+        req = self._active.pop(slot)
+        req.preemptions += 1
+        self._flight.record(
+            "preempt", request=req.id, tenant=req.tenant, slot=slot,
+            committed_tokens=len(req.tokens),
+            preemptions=req.preemptions, cause=cause,
+        )
+        self.metrics.record_preemption(req.tenant)
+        self._release_slot_pages(slot, req, donate=True)
+        if req.preemptions > self.max_preemptions:
+            req.finish(
+                "error",
+                f"request {req.id} (tenant '{req.tenant}') preempted "
+                f"{req.preemptions}x under page pressure ({cause}); "
+                f"giving up after max_preemptions={self.max_preemptions}",
+            )
+        else:
+            self._preempted.append(req)
+
+    def drain_preempted(self) -> List[Request]:
+        """Preempted-but-resumable requests since the last call — the
+        serving loop re-queues them (scheduler.requeue)."""
+        out, self._preempted = self._preempted, []
+        return out
+
+    def _ensure_pages(self, window: int) -> List[int]:
+        """Grow every active slot's page chain to cover its next
+        ``window`` writes.  Under pressure: evict cold prefix pages
+        first, then preempt victims (newest, lowest-priority first).
+        Returns the slots freed by preemption."""
+        freed: List[int] = []
+        pool = self.pool
+        for slot in sorted(self._active):
+            if slot not in self._active:
+                continue
+            need_tokens = min(int(self._pos[slot]) + window, self.max_len)
+            need = min(pool.pages_for(need_tokens), pool.pages_per_slot)
+            short = need - pool.slot_page_count(slot)
+            if short <= 0:
+                continue
+            pages = None
+            while slot in self._active:
+                pages = pool.allocate(short)
+                if pages is not None:
+                    break
+                want = short - pool.free_count()
+                cause = (
+                    f"page_pressure: slot {slot} needs {short} page(s), "
+                    f"{pool.free_count()} free of {self.kv_pages - 1}"
+                )
+                if (
+                    self._prefix is not None
+                    and self._prefix.evict(want) > 0
+                ):
+                    continue
+                victim = self._pick_victim(exclude=slot)
+                if victim is None:
+                    # Nothing left to shed but this slot itself.
+                    self._preempt(slot, cause)
+                    freed.append(slot)
+                    break
+                self._preempt(victim, cause)
+                freed.append(victim)
+            if pages is not None and slot in self._active:
+                pool.extend_slot(slot, pages)
+        if freed:
+            self._push_kv_metrics()
+        return freed
 
     # -- serving ---------------------------------------------------------
 
@@ -297,70 +628,194 @@ class SlotDecodeEngine:
     def active_count(self) -> int:
         return len(self._active)
 
-    def admit(self, req: Request, slot: int) -> bool:
+    def admit(self, req: Request, slot: int) -> str:
         """Prefill ``req`` into ``slot`` and emit its first token.
-        Returns False when the request finished immediately (EOS on
-        token 0, or a one-token budget) — the caller recycles the slot."""
+        Returns ``"active"`` (decoding), ``"finished"`` (EOS on token 0
+        or a one-token budget — the caller recycles the slot), or
+        ``"no_memory"`` (paged mode: the pool cannot hold the prompt
+        right now — the caller re-queues the request and retries once
+        running requests free pages)."""
         if slot in self._active:
             raise ValueError(f"slot {slot} is already occupied")
+        # Effective prompt: original prompt plus any tokens committed
+        # before a preemption — resume is just admission with a longer
+        # prompt (and the fold counter picking up where it left off).
+        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
+        done_tokens = len(req.tokens)
+        if done_tokens:
+            prompt = np.concatenate(
+                [prompt, np.asarray(req.tokens, np.int32)]
+            )
+        p = prompt.shape[0]
+        key = _as_key(req.rng)
+
+        shared: List[int] = []
+        c = 0
+        if self.paged:
+            if self._prefix is not None:
+                shared, c = self._prefix.lookup(
+                    prompt, (p - 1) // self.kv_page_size
+                )
+                req.prefix_hit_tokens = c
+            # Cover the prompt plus the first decode window so a fresh
+            # admission cannot immediately trigger preemption.
+            total_need = self.pool.pages_for(
+                min(p + 1 + self.spec_k, self.max_len)
+            )
+            n_new = total_need - len(shared)
+            pages = self.pool.allocate(n_new)
+            if pages is None and self._prefix is not None:
+                self._prefix.evict(n_new - self.pool.free_count())
+                pages = self.pool.allocate(n_new)
+            if pages is None:
+                if shared:
+                    self.pool.release(shared)
+                if not self._active:
+                    # Nothing running will ever free pages: the pool is
+                    # simply too small for this request.  Structured
+                    # error instead of an unserveable queue entry.
+                    req.finish(
+                        "error",
+                        f"kv pool exhausted: request {req.id} (tenant "
+                        f"'{req.tenant}') needs {n_new} page(s) beyond "
+                        f"its prefix hit, pool has "
+                        f"{self.pool.free_count()} of {self.kv_pages - 1}",
+                    )
+                    return "finished"
+                self.metrics.record_admission_blocked()
+                return "no_memory"
+            self.pool.bind_slot(slot, shared + pages)
+
         req.slot = slot
         req.state = "active"
-        prompt = np.asarray(req.prompt, np.int32).reshape(-1)
-        p = prompt.shape[0]
-        bucket = min(1 << (p - 1).bit_length(), self.max_len)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :p] = prompt
-        key = _as_key(req.rng)
-        run = self._program(
-            ("serve_prefill", self.model, bucket),
-            lambda: self._build_prefill(bucket),
-        )
         t0 = time.perf_counter()
-        with span("serve_prefill", prompt_len=p, bucket=bucket, slot=slot):
-            cache1, tok0 = run(
-                self.params, padded, np.int32(p),
-                jnp.asarray(req.temperature, jnp.float32), key,
+        if self.paged and c > 0:
+            tok0 = self._admit_paged_continuation(
+                req, slot, prompt, c, key, done_tokens
             )
-            self.cache, self.tok = self._insert(
-                self.cache, self.tok, cache1, tok0, np.int32(slot),
-                np.int32(p)
+        else:
+            tok0 = self._admit_full_prefill(
+                req, slot, prompt, key, done_tokens
             )
         if self.spec_k:
-            self._pos[slot] = p
             self._caps[slot] = min(
-                p + req.max_new_tokens - 1, self.max_len - self.spec_k - 1
+                p + (req.max_new_tokens - done_tokens) - 1,
+                self.max_len - self.spec_k - 1,
             )
             if self._draft is not None:
-                # The draft model prefills the same padded prompt into
-                # ITS slot cache (its own bucketed programs); the draft
-                # prefill's sampled token is discarded — only the K/V
-                # state matters for drafting.
-                d_run = self._program(
-                    ("serve_prefill", self._draft.model, bucket),
-                    lambda: self._build_prefill(
-                        bucket, self._draft_dm, self._draft_shapes_b1
-                    ),
-                )
-                d_cache1, d_tok0 = d_run(
-                    self._draft.params, padded, np.int32(p),
-                    jnp.asarray(req.temperature, jnp.float32), key,
-                )
-                self._draft_cache, self._draft_tok = self._draft_insert(
-                    self._draft_cache, self._draft_tok, d_cache1, d_tok0,
-                    np.int32(slot), np.int32(p),
-                )
+                self._admit_draft(prompt, slot, key, req.temperature)
+        self._pos[slot] = p
         tok0 = np.asarray(tok0)  # blocks until prefill + insert land
         self.metrics.record_prefill(time.perf_counter() - t0)
         self._temps[slot] = req.temperature
         self._rngs[slot] = key
-        self._steps[slot] = 1
-        token = int(tok0[0])
+        self._steps[slot] = done_tokens + 1
+        if self.paged:
+            if self._prefix is not None:
+                # Register the prompt's full blocks NOW (the prefill
+                # that fills them is already dispatched, and the device
+                # stream serializes) so the next same-prefix request —
+                # even one admitted this very batch — hits.
+                self._prefix.insert(
+                    prompt,
+                    self.pool.slot_pages[slot][: p // self.kv_page_size],
+                )
+            self._push_kv_metrics()
+        token = int(tok0.reshape(-1)[0])
         req.push_token(token)
-        self.metrics.record_ttft(time.monotonic() - req.submitted_at)
+        if done_tokens == 0:
+            self.metrics.record_ttft(time.monotonic() - req.submitted_at)
         self._active[slot] = req
         if self._finished(req, token):
-            return False
-        return True
+            return "finished"
+        return "active"
+
+    def _admit_full_prefill(self, req, slot, prompt, key, done_tokens):
+        """The contiguous batch-1 prefill + slot insert (paged mode
+        scatter-inserts the SAME program's cache into pages — the
+        byte-identity anchor)."""
+        p = prompt.shape[0]
+        bucket = min(1 << (p - 1).bit_length(), self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = prompt
+        run = self._program(
+            ("serve_prefill", self.model, bucket),
+            lambda: self._build_prefill(bucket),
+        )
+        with span("serve_prefill", prompt_len=p, bucket=bucket, slot=slot):
+            cache1, tok0 = run(
+                self.params, padded, np.int32(p),
+                jnp.asarray(req.temperature, jnp.float32), key,
+                np.int32(done_tokens),
+            )
+            if self.paged:
+                self.cache, self.tok = self._insert(
+                    self.cache, self.tok, cache1, tok0, np.int32(slot),
+                    np.int32(p), jnp.asarray(self._page_row(slot)),
+                )
+            else:
+                self.cache, self.tok = self._insert(
+                    self.cache, self.tok, cache1, tok0, np.int32(slot),
+                    np.int32(p)
+                )
+        return tok0
+
+    # Continuation windows bucket to powers of two like prefill, but
+    # floored: suffix lengths collapse from log2(max_len) buckets to a
+    # handful (8, 16, 32, ...), so steady-state traffic — where a repeat
+    # prompt can self-hit down to a 1-token suffix — stops minting new
+    # compiles for every tiny suffix length.  Padding cost is at most 7
+    # wasted window positions.
+    _MIN_SUFFIX_BUCKET = 8
+
+    def _admit_paged_continuation(self, req, slot, prompt, c, key,
+                                  done_tokens):
+        """Prefix hit: skip the shared ``c`` tokens entirely; run only
+        the suffix window through the paged continuation program."""
+        p = prompt.shape[0]
+        su = p - c
+        bucket = min(
+            max(self._MIN_SUFFIX_BUCKET, 1 << (su - 1).bit_length()),
+            self.max_len,
+        )
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :su] = prompt[c:]
+        run = self._program(
+            ("serve_prefill_paged", self._key_model, bucket),
+            lambda: self._build_prefill_paged(bucket),
+        )
+        with span("serve_prefill_paged", prompt_len=p, prefix_hit=c,
+                  bucket=bucket, slot=slot):
+            self.cache, self.tok, tok0 = run(
+                self.cache, self.tok, self.params, padded, np.int32(su),
+                np.int32(c), jnp.asarray(self._page_row(slot)),
+                jnp.asarray(req.temperature, jnp.float32), key,
+                np.int32(done_tokens), np.int32(slot),
+            )
+        return tok0
+
+    def _admit_draft(self, prompt, slot, key, temperature):
+        """Prefill the draft model's own (contiguous) slot cache with
+        the same effective prompt; its sampled token is discarded — only
+        the K/V state matters for drafting."""
+        p = prompt.shape[0]
+        bucket = min(1 << (p - 1).bit_length(), self.max_len)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = prompt
+        d_run = self._program(
+            ("serve_prefill", self._draft.model, bucket),
+            lambda: self._build_prefill(
+                bucket, self._draft_dm, self._draft_shapes_b1
+            ),
+        )
+        d_cache1, d_tok0 = d_run(
+            self._draft.params, padded, np.int32(p),
+            jnp.asarray(temperature, jnp.float32), key, np.int32(0),
+        )
+        self._draft_cache, self._draft_tok = self._draft_insert(
+            self._draft_cache, self._draft_tok, d_cache1, d_tok0,
+            np.int32(slot), np.int32(p),
+        )
 
     def _finished(self, req: Request, token: int) -> bool:
         """Finish-and-unbind if ``req`` just completed; True if so."""
@@ -370,13 +825,15 @@ class SlotDecodeEngine:
         if done:
             req.finish("done")
             self.metrics.record_completion()
+            self._release_slot_pages(req.slot, req, donate=True)
             del self._active[req.slot]
         return done
 
     def step(self) -> List[int]:
         """One compiled decode step over all slots; distributes each
-        active slot's token(s) and returns the slots freed this step.
-        In spec mode each slot advances 1..spec_k+1 tokens."""
+        active slot's token(s) and returns the slots freed this step
+        (finished, expired, or preempted).  In spec mode each slot
+        advances 1..spec_k+1 tokens."""
         if not self._active:
             return []
         self._step_seq += 1
@@ -398,8 +855,16 @@ class SlotDecodeEngine:
             fault = plan.fire("decode_wedge", step=self._step_seq)
             if fault is not None:
                 plan.hold_wedge(fault)
+        preempt_freed: List[int] = []
+        if self.paged:
+            preempt_freed = self._ensure_pages(
+                self.spec_k + 1 if self.spec_k else 1
+            )
+            self._sync_table()
+            if not self._active:
+                return preempt_freed
         if self.spec_k:
-            return self._step_spec()
+            return preempt_freed + self._step_spec()
         active_before = len(self._active)
         t0 = time.perf_counter()
         with span("serve_decode", engine_step=self._step_seq,
@@ -410,6 +875,8 @@ class SlotDecodeEngine:
             )
             toks = np.asarray(self.tok[:, 0])  # blocks: the step landed
         dt = time.perf_counter() - t0
+        # Host mirror of the device's idx += 1 (every row advances).
+        self._pos = np.minimum(self._pos + 1, self.max_len).astype(np.int32)
         freed: List[int] = []
         emitted = 0
         now = time.monotonic()
@@ -422,6 +889,7 @@ class SlotDecodeEngine:
                     f"after {len(req.tokens)} token(s)",
                 )
                 self.metrics.record_expiry()
+                self._release_slot_pages(slot, req, donate=True)
                 del self._active[slot]
                 freed.append(slot)
                 continue
@@ -432,7 +900,7 @@ class SlotDecodeEngine:
             if self._finished(req, token):
                 freed.append(slot)
         self.metrics.record_step(dt, active_before, self.max_batch, emitted)
-        return freed
+        return preempt_freed + freed
 
     def _step_spec(self) -> List[int]:
         """One speculative verify step over all slots: draft spec_k
@@ -489,6 +957,7 @@ class SlotDecodeEngine:
                     f"after {len(req.tokens)} token(s)",
                 )
                 self.metrics.record_expiry()
+                self._release_slot_pages(slot, req, donate=True)
                 del self._active[slot]
                 freed.append(slot)
                 continue

@@ -80,6 +80,21 @@ class ServingMetrics:
         self.watchdog_trips = 0
         self.max_active_slots = 0
         self.queue_depth = 0
+        # Paged KV + prefix cache + multi-tenant scheduling (PR6): pool
+        # occupancy gauges, token-weighted prefix hit accounting,
+        # preemption counters, and a per-tenant ledger published as
+        # labeled ``serving_tenant_*`` gauges.
+        self.kv_pages_total = 0
+        self.kv_pages_free = 0
+        self.kv_pages_used = 0
+        self.prefix_cache_nodes = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_lookup_tokens = 0
+        self.preemptions_total = 0
+        self.admissions_blocked = 0
+        self._tenants: dict = {}
         # Speculative decoding (engine spec mode): acceptance accounting.
         # One histogram entry per (verify step, active slot); keys are
         # accepted-draft counts 0..K.
@@ -125,14 +140,33 @@ class ServingMetrics:
                 self._first_step_at = now - seconds
             self._last_step_at = now
 
-    def record_admission(self, queue_depth: int) -> None:
+    def _tenant(self, tenant: str) -> dict:
+        # Caller holds the lock.
+        t = self._tenants.get(tenant)
+        if t is None:
+            t = self._tenants[tenant] = {
+                "admitted": 0, "rejected": 0, "preempted": 0,
+                "queue_depth": 0,
+            }
+        return t
+
+    def record_admission(self, queue_depth: int,
+                         tenant: Optional[str] = None,
+                         tenant_depth: Optional[int] = None) -> None:
         with self._lock:
             self.requests_admitted += 1
             self.queue_depth = int(queue_depth)
+            if tenant is not None:
+                t = self._tenant(tenant)
+                t["admitted"] += 1
+                if tenant_depth is not None:
+                    t["queue_depth"] = int(tenant_depth)
 
-    def record_rejection(self) -> None:
+    def record_rejection(self, tenant: Optional[str] = None) -> None:
         with self._lock:
             self.requests_rejected += 1
+            if tenant is not None:
+                self._tenant(tenant)["rejected"] += 1
 
     def record_completion(self) -> None:
         with self._lock:
@@ -150,9 +184,45 @@ class ServingMetrics:
         with self._lock:
             self.watchdog_trips += 1
 
-    def record_queue_depth(self, depth: int) -> None:
+    def record_queue_depth(self, depth: int,
+                           tenant: Optional[str] = None,
+                           tenant_depth: Optional[int] = None) -> None:
         with self._lock:
             self.queue_depth = int(depth)
+            if tenant is not None and tenant_depth is not None:
+                self._tenant(tenant)["queue_depth"] = int(tenant_depth)
+
+    def record_preemption(self, tenant: str) -> None:
+        """One preempt-and-requeue under page pressure."""
+        with self._lock:
+            self.preemptions_total += 1
+            self._tenant(tenant)["preempted"] += 1
+
+    def record_admission_blocked(self) -> None:
+        """An admission deferred because the page pool could not hold
+        the prompt (the request re-queued, not rejected)."""
+        with self._lock:
+            self.admissions_blocked += 1
+
+    def record_kv(self, free: int, used: int, total: int,
+                  prefix_nodes: int) -> None:
+        """Paged-pool occupancy snapshot (allocatable pages — the trash
+        page is excluded from ``total``)."""
+        with self._lock:
+            self.kv_pages_free = int(free)
+            self.kv_pages_used = int(used)
+            self.kv_pages_total = int(total)
+            self.prefix_cache_nodes = int(prefix_nodes)
+
+    def record_prefix_stats(self, hits: int, misses: int,
+                            hit_tokens: int, lookup_tokens: int) -> None:
+        """Cumulative prefix-cache counters (token-weighted hit rate:
+        hit_tokens / lookup_tokens)."""
+        with self._lock:
+            self.prefix_hits = int(hits)
+            self.prefix_misses = int(misses)
+            self.prefix_hit_tokens = int(hit_tokens)
+            self.prefix_lookup_tokens = int(lookup_tokens)
 
     def record_spec(self, accepted_counts, draft_k: int) -> None:
         """One speculative verify step: per-active-slot accepted-draft
@@ -212,6 +282,22 @@ class ServingMetrics:
                 "requests_expired": self.requests_expired,
                 "engine_errors": self.engine_errors,
                 "watchdog_trips": self.watchdog_trips,
+                "kv_pages_total": self.kv_pages_total,
+                "kv_pages_free": self.kv_pages_free,
+                "kv_pages_used": self.kv_pages_used,
+                "prefix_cache_nodes": self.prefix_cache_nodes,
+                "prefix_hits": self.prefix_hits,
+                "prefix_misses": self.prefix_misses,
+                "prefix_tokens_saved": self.prefix_hit_tokens,
+                "prefix_hit_rate": round(
+                    self.prefix_hit_tokens / self.prefix_lookup_tokens, 4
+                ) if self.prefix_lookup_tokens else 0.0,
+                "preemptions_total": self.preemptions_total,
+                "admissions_blocked": self.admissions_blocked,
+                "tenants": {
+                    name: dict(stats)
+                    for name, stats in sorted(self._tenants.items())
+                },
                 "spec_draft_k": self.spec_draft_k,
                 "spec_steps_total": self.spec_steps_total,
                 "spec_drafted_tokens": self.spec_drafted_tokens,
@@ -261,6 +347,18 @@ class ServingMetrics:
         r = registry if registry is not None else default_registry()
         snap = self.snapshot()
         for key, value in snap.items():
+            if key == "tenants":
+                # Per-tenant ledger -> labeled serving_tenant_* gauges
+                # (the PR5 cluster_<field>{host=} arrangement applied to
+                # tenants): one series per (field, tenant).
+                for tenant, stats in value.items():
+                    for fname, fval in stats.items():
+                        r.gauge(
+                            f"serving_tenant_{fname}",
+                            f"per-tenant {fname}",
+                            labelnames=("tenant",),
+                        ).labels(tenant=tenant).set(float(fval))
+                continue
             if key == "spec_accept_hist":
                 h = r.histogram(
                     "serving_spec_accept",
@@ -279,4 +377,12 @@ class ServingMetrics:
                         h.observe(float(a))
                 continue
             r.gauge(f"serving_{key}").set(float(value))
+        # The JSONL sink (ML_TRAINER_TPU_METRICS_JSONL) gets the same
+        # snapshot as one ``serving_metrics`` record — the no-scraper
+        # path, same idiom as train_metrics' per-sync registry write.
+        from ml_trainer_tpu.telemetry.export import default_sink
+
+        sink = default_sink()
+        if sink is not None:
+            sink.write(snap, kind="serving_metrics")
         return snap

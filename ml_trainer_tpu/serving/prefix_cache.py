@@ -1,0 +1,182 @@
+"""Radix cache of KV pages keyed on token-block prefixes.
+
+Production traffic is prefix-heavy: the same system prompt, the same
+few-shot preamble, the same retrieval header lead thousands of requests.
+The contiguous engine re-prefills those tokens for every user.  This
+cache maps PAGE-SIZE token blocks to already-filled KV pages, so a new
+request walks the radix tree, pins the longest matching chain of pages,
+and prefills only its unshared suffix (engine: the paged continuation
+window).
+
+Design (the vLLM/SGLang block-hash arrangement, as a radix trie):
+
+* **Block granularity.**  A node keys on a tuple of exactly
+  ``page_size`` tokens; its page holds those positions' K/V, valid only
+  under the node's full root path (causal attention makes a position's
+  K/V a function of its entire prefix — the trie path IS that prefix).
+  Sharing below block granularity would require copying partial pages;
+  at block granularity a divergent request simply stops matching at the
+  last full block and writes its own fresh pages from there —
+  copy-on-write by construction, since shared pages are never written
+  (appends start on the first un-shared page boundary).
+* **Refcount-tied eviction.**  Cache residency holds one pool refcount
+  per page.  ``evict`` walks leaves in LRU order and only frees pages
+  with no other holder (refcount 1), so a page some slot is actively
+  attending can never be reclaimed out from under it.
+* **Donation.**  Completed and PREEMPTED requests insert their written
+  full blocks (prompt and generated tokens alike) before their slot
+  releases, so a preempt-and-requeue victim resumes by re-pinning its
+  own pages — resume prefill shrinks to the last partial block.
+
+Single-threaded like the pool: only the engine loop touches it.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.serving.kv_pool import KVPagePool
+
+
+class _Node:
+    __slots__ = ("block", "page", "children", "parent", "last_used")
+
+    def __init__(self, block: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"]):
+        self.block = block
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Radix trie over page-size token blocks -> refcounted KV pages."""
+
+    def __init__(self, pool: KVPagePool):
+        self.pool = pool
+        self.page_size = pool.page_size
+        self._root = _Node((), 0, None)
+        self._clock = itertools.count(1)
+        self._nodes = 0
+        # Stats feeding serving metrics: hit rate is hit_tokens over
+        # lookup_tokens (token-weighted — one long hit matters more than
+        # three empty ones).
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.lookup_tokens = 0
+
+    def __len__(self) -> int:
+        return self._nodes
+
+    # -- read ------------------------------------------------------------
+
+    def lookup(self, tokens: np.ndarray, max_blocks: int) -> Tuple[List[int], int]:
+        """Longest cached chain for ``tokens`` (at most ``max_blocks``
+        full blocks).  Returns ``(pages, matched_tokens)`` with every
+        returned page ALREADY retained for the caller (one pool count
+        each) — the slot owns those references until its reset."""
+        toks = np.asarray(tokens).reshape(-1)
+        ps = self.page_size
+        limit = min(int(max_blocks), len(toks) // ps)
+        node = self._root
+        pages: List[int] = []
+        now = next(self._clock)
+        for i in range(limit):
+            key = tuple(int(t) for t in toks[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                break
+            child.last_used = now
+            pages.append(child.page)
+            node = child
+        self.pool.retain(pages)
+        matched = len(pages) * ps
+        self.lookup_tokens += limit * ps
+        self.hit_tokens += matched
+        if pages:
+            self.hits += 1
+        else:
+            self.misses += 1
+        return pages, matched
+
+    def hit_rate(self) -> float:
+        return (
+            self.hit_tokens / self.lookup_tokens
+            if self.lookup_tokens else 0.0
+        )
+
+    # -- write -----------------------------------------------------------
+
+    def insert(self, tokens: np.ndarray, pages: List[int]) -> int:
+        """Register a slot's filled chain: block ``i`` of ``tokens`` is
+        held by ``pages[i]``.  Blocks already cached are skipped (the
+        first writer wins; the duplicate page stays slot-owned and frees
+        with the slot); new nodes retain their page for cache residency.
+        Returns the number of newly registered blocks."""
+        toks = np.asarray(tokens).reshape(-1)
+        ps = self.page_size
+        n_blocks = min(len(pages), len(toks) // ps)
+        node = self._root
+        added = 0
+        now = next(self._clock)
+        for i in range(n_blocks):
+            key = tuple(int(t) for t in toks[i * ps:(i + 1) * ps])
+            child = node.children.get(key)
+            if child is None:
+                page = pages[i]
+                if page == 0:
+                    break  # trash can never carry cacheable K/V
+                self.pool.retain([page])
+                child = _Node(key, page, node)
+                node.children[key] = child
+                self._nodes += 1
+                added += 1
+            child.last_used = now
+            node = child
+        return added
+
+    # -- eviction --------------------------------------------------------
+
+    def evict(self, want_pages: int) -> int:
+        """Free up to ``want_pages`` pool pages by dropping LRU leaves
+        whose pages have no other holder (refcount 1 — cache residency
+        only).  Interior nodes become evictable as their children go, so
+        the loop keeps sweeping until it frees enough or nothing moves.
+        Returns pages actually freed."""
+        freed = 0
+        while freed < want_pages:
+            candidates = [
+                n for n in self._leaves()
+                if self.pool.refcount[n.page] == 1
+            ]
+            if not candidates:
+                break
+            candidates.sort(key=lambda n: n.last_used)
+            progressed = False
+            for node in candidates:
+                if freed >= want_pages:
+                    break
+                self._drop(node)
+                freed += self.pool.release([node.page])
+                progressed = True
+            if not progressed:
+                break
+        return freed
+
+    def _leaves(self):
+        stack = list(self._root.children.values())
+        while stack:
+            node = stack.pop()
+            if node.children:
+                stack.extend(node.children.values())
+            else:
+                yield node
+
+    def _drop(self, node: _Node) -> None:
+        del node.parent.children[node.block]
+        self._nodes -= 1

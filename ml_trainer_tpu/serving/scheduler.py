@@ -1,33 +1,55 @@
-"""Admission control and slot bookkeeping for the decode engine.
+"""Admission control: multi-tenant weighted scheduling + slot bookkeeping.
 
-FIFO with backpressure: a bounded pending queue admits requests in
-arrival order; past the watermark ``submit`` raises ``AdmissionError``
-immediately (reject-with-error beats unbounded queues — the caller can
-shed load or retry with jitter, and the engine's memory stays bounded by
-``max_queue + max_batch`` requests).  Per-request deadlines are enforced
-at every hand-off point: a queued request whose deadline passes is
-expired instead of admitted, and the engine expires active requests
-between decode steps.  Slots (rows of the engine's preallocated cache
-block) recycle the moment a request finishes — EOS, token budget, or
-deadline — so the next queued request joins the running batch at a token
-boundary.
+PR1's scheduler was FIFO with backpressure — correct for one well-behaved
+caller, defenseless against the production reality of many tenants with
+unequal importance: one chatty tenant starves everyone behind a shared
+watermark.  This scheduler keeps the same hand-off surface (``submit`` /
+``acquire`` / ``release`` / ``drain_pending``) and replaces arrival-order
+admission with:
+
+* **Per-tenant queues + quotas.**  Every request carries a ``tenant``;
+  each tenant has a :class:`TenantConfig` — admission ``weight``,
+  ``max_active`` (concurrent slots it may hold) and ``max_queued``
+  (its own watermark inside the global one).  Unknown tenants get the
+  default config, so single-tenant callers see exactly the old FIFO
+  behavior (one tenant, arrival order — ``FifoScheduler`` remains as an
+  alias).
+* **Weighted admission (stride scheduling).**  Each admission charges
+  the picked tenant ``1/weight`` of virtual time; ``acquire`` picks the
+  eligible tenant with the lowest pass.  A weight-3 tenant gets 3× the
+  admissions of a weight-1 tenant under contention, and an idle tenant
+  re-enters at the current floor instead of burning saved-up credit in
+  a burst.
+* **Priorities.**  Within a tenant, higher ``priority`` admits first;
+  ties admit in arrival order.
+* **Preempt-and-requeue.**  The paged engine preempts long generations
+  under page pressure (engine.py); ``requeue`` puts the victim BACK at
+  the front of its tenant's queue (it keeps its original arrival seq, so
+  it sorts ahead of later arrivals at the same priority) with its
+  generated tokens intact — re-admission resumes from them as a
+  prefix, and the prefix cache usually makes the resume prefill cheap.
+
+Deadlines are enforced at every hand-off point exactly as before: a
+queued request whose deadline passes is expired instead of admitted, and
+the engine expires active requests between decode steps.
 """
 
 from __future__ import annotations
 
-import collections
+import heapq
 import itertools
 import queue as _queue
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 
 class AdmissionError(RuntimeError):
-    """The pending queue is at its watermark; the request was rejected."""
+    """The pending queue (global or per-tenant) is at its watermark; the
+    request was rejected."""
 
 
 class DeadlineExceeded(RuntimeError):
@@ -45,6 +67,36 @@ _ids = itertools.count()
 # Stream sentinels (queue items are plain ints otherwise).
 _DONE = ("done", None)
 
+DEFAULT_TENANT = "default"
+
+
+@dataclass
+class TenantConfig:
+    """Per-tenant scheduling policy.
+
+    ``weight``: share of admissions under contention (stride
+    scheduling — a weight-2 tenant admits twice as often as weight-1).
+    ``max_active``: concurrent slots the tenant may occupy (None =
+    engine-wide limit only).  ``max_queued``: the tenant's own pending
+    watermark inside the global ``max_queue`` (None = global only).
+    """
+
+    weight: float = 1.0
+    max_active: Optional[int] = None
+    max_queued: Optional[int] = None
+
+    def __post_init__(self):
+        if self.weight <= 0:
+            raise ValueError(f"weight must be > 0, got {self.weight}")
+        if self.max_active is not None and self.max_active < 1:
+            raise ValueError(
+                f"max_active must be >= 1 or None, got {self.max_active}"
+            )
+        if self.max_queued is not None and self.max_queued < 0:
+            raise ValueError(
+                f"max_queued must be >= 0 or None, got {self.max_queued}"
+            )
+
 
 @dataclass
 class Request:
@@ -55,7 +107,10 @@ class Request:
     ``temperature > 0`` — an int seed or a jax PRNG key; the engine folds
     the per-token counter exactly like ``generate()`` does, so a request
     at seed ``s`` reproduces ``generate(..., rng=jax.random.PRNGKey(s))``
-    token-for-token."""
+    token-for-token.  ``tenant``/``priority`` feed the multi-tenant
+    scheduler; ``preemptions`` counts how often the paged engine evicted
+    this request under page pressure (each time it re-queued with its
+    generated tokens as a resumable prefix)."""
 
     prompt: np.ndarray
     max_new_tokens: int
@@ -63,6 +118,8 @@ class Request:
     rng: object = None
     eos_token_id: Optional[int] = None
     deadline: Optional[float] = None
+    tenant: str = DEFAULT_TENANT
+    priority: int = 0
 
     id: int = field(default_factory=lambda: next(_ids))
     submitted_at: float = field(default_factory=time.monotonic)
@@ -76,6 +133,13 @@ class Request:
     # (accepted drafts / verify steps -> its personal acceptance rate).
     spec_steps: int = 0
     spec_accepted_tokens: int = 0
+    # Paged engine bookkeeping: scheduler arrival seq (requeued victims
+    # keep theirs, so they resume ahead of later arrivals), preemption
+    # count, and how many prompt tokens the prefix cache let us skip.
+    seq: Optional[int] = None
+    preemptions: int = 0
+    prefix_hit_tokens: int = 0
+    admitted_at: Optional[float] = None
     error: Optional[str] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
@@ -106,54 +170,143 @@ class Request:
         self._stream.put(_DONE)
 
 
-class FifoScheduler:
-    """Bounded FIFO admission + free-slot pool (thread-safe)."""
+class TenantScheduler:
+    """Weighted multi-tenant admission + free-slot pool (thread-safe).
+
+    With one tenant and default config this IS the old bounded FIFO:
+    global watermark, arrival order, reject-with-error past the
+    watermark (the caller sheds load or retries with jitter, and memory
+    stays bounded by ``max_queue + max_batch`` requests).
+    """
 
     def __init__(self, max_batch: int, max_queue: int = 64,
-                 metrics=None):
+                 metrics=None,
+                 tenants: Optional[Dict[str, TenantConfig]] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue < 0:
             raise ValueError(f"max_queue must be >= 0, got {max_queue}")
         self.max_queue = max_queue
         self._lock = threading.Lock()
-        self._pending: collections.deque = collections.deque()
+        self.tenants: Dict[str, TenantConfig] = dict(tenants or {})
+        # heap entries (-priority, seq, req) per tenant
+        self._queues: Dict[str, list] = {}
+        self._passes: Dict[str, float] = {}
+        self._active: Dict[str, int] = {}
+        self._slot_tenant: Dict[int, str] = {}
+        self._total_queued = 0
+        self._seq = itertools.count()
         self._free_slots = list(range(max_batch - 1, -1, -1))  # pop() -> 0 first
         self._metrics = metrics
+
+    def _cfg(self, tenant: str) -> TenantConfig:
+        cfg = self.tenants.get(tenant)
+        if cfg is None:
+            cfg = self.tenants[tenant] = TenantConfig()
+        return cfg
 
     # -- producer side ---------------------------------------------------
 
     def submit(self, req: Request) -> None:
-        """Enqueue or raise ``AdmissionError`` past the watermark."""
+        """Enqueue or raise ``AdmissionError`` past a watermark (global
+        first, then the tenant's own)."""
         with self._lock:
-            if len(self._pending) >= self.max_queue:
+            cfg = self._cfg(req.tenant)
+            if self._total_queued >= self.max_queue:
                 if self._metrics is not None:
-                    self._metrics.record_rejection()
+                    self._metrics.record_rejection(req.tenant)
                 raise AdmissionError(
                     f"pending queue at watermark ({self.max_queue}); "
                     f"request {req.id} rejected"
                 )
-            self._pending.append(req)
+            q = self._queues.setdefault(req.tenant, [])
+            if cfg.max_queued is not None and len(q) >= cfg.max_queued:
+                if self._metrics is not None:
+                    self._metrics.record_rejection(req.tenant)
+                raise AdmissionError(
+                    f"tenant '{req.tenant}' queue at its quota "
+                    f"({cfg.max_queued}); request {req.id} rejected"
+                )
+            self._enqueue(req, q)
             if self._metrics is not None:
-                self._metrics.record_admission(len(self._pending))
+                self._metrics.record_admission(
+                    self._total_queued, req.tenant, len(q)
+                )
+
+    def requeue(self, req: Request) -> None:
+        """Put a PREEMPTED request back at the head of its tenant's
+        queue (original seq, so it sorts ahead of later arrivals at the
+        same priority).  Bypasses the watermarks — the request was
+        already admitted once and its client is still streaming."""
+        req.state = "queued"
+        req.slot = -1
+        with self._lock:
+            self._enqueue(req, self._queues.setdefault(req.tenant, []))
+
+    def _enqueue(self, req: Request, q: list) -> None:
+        if req.seq is None:
+            req.seq = next(self._seq)
+        if not q:
+            # A tenant re-entering from idle starts at the current pass
+            # floor: it competes fairly from NOW instead of spending its
+            # idle time as a burst of back-to-back admissions.
+            floor = min(
+                (self._passes[t] for t, tq in self._queues.items() if tq),
+                default=0.0,
+            )
+            self._passes[req.tenant] = max(
+                self._passes.get(req.tenant, 0.0), floor
+            )
+        heapq.heappush(q, (-req.priority, req.seq, req))
+        self._total_queued += 1
 
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._pending)
+            return self._total_queued
+
+    def tenant_depths(self) -> Dict[str, int]:
+        with self._lock:
+            return {t: len(q) for t, q in self._queues.items() if q}
 
     # -- engine side -----------------------------------------------------
+
+    def _pick_tenant(self) -> Optional[str]:
+        best = None
+        for t, q in self._queues.items():
+            if not q:
+                continue
+            cfg = self._cfg(t)
+            if (
+                cfg.max_active is not None
+                and self._active.get(t, 0) >= cfg.max_active
+            ):
+                continue
+            key = (self._passes.get(t, 0.0), t)
+            if best is None or key < best[0]:
+                best = (key, t)
+        return best[1] if best is not None else None
 
     def acquire(self) -> Optional[tuple]:
         """Next admissible (request, slot) pair, or None.
 
-        Skips (and expires) queued requests whose deadline already
-        passed — they would only waste a prefill.  Returns None when no
-        slot is free or the queue is empty."""
+        Picks the lowest-pass eligible tenant (stride scheduling), then
+        that tenant's highest-priority oldest request.  Skips (and
+        expires) queued requests whose deadline already passed — they
+        would only waste a prefill.  Returns None when no slot is free,
+        nothing is queued, or every queued tenant is at its
+        ``max_active`` quota."""
         with self._lock:
-            while self._pending and self._free_slots:
-                req = self._pending.popleft()
+            while self._free_slots and self._total_queued:
+                tenant = self._pick_tenant()
+                if tenant is None:
+                    return None
+                q = self._queues[tenant]
+                req = heapq.heappop(q)[2]
+                self._total_queued -= 1
                 if self._metrics is not None:
-                    self._metrics.record_queue_depth(len(self._pending))
+                    self._metrics.record_queue_depth(
+                        self._total_queued, tenant, len(q)
+                    )
                 if req.expired():
                     req.finish(
                         "expired",
@@ -162,28 +315,51 @@ class FifoScheduler:
                     if self._metrics is not None:
                         self._metrics.record_expiry()
                     continue
-                req.slot = self._free_slots.pop()
+                cfg = self._cfg(tenant)
+                self._passes[tenant] = (
+                    self._passes.get(tenant, 0.0) + 1.0 / cfg.weight
+                )
+                self._active[tenant] = self._active.get(tenant, 0) + 1
+                slot = self._free_slots.pop()
+                self._slot_tenant[slot] = tenant
+                req.slot = slot
                 req.state = "active"
-                return req, req.slot
+                req.admitted_at = time.monotonic()
+                return req, slot
             return None
+
+    def active_counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {t: n for t, n in self._active.items() if n}
 
     def drain_pending(self) -> list:
         """Pop and return EVERY queued request (no slot assignment) — the
         shutdown/watchdog path uses this to fail them loudly instead of
         leaving their streams blocked forever."""
         with self._lock:
-            out = list(self._pending)
-            self._pending.clear()
+            out = [
+                entry[2] for q in self._queues.values() for entry in q
+            ]
+            out.sort(key=lambda r: (-r.priority, r.seq or 0))
+            self._queues.clear()
+            self._total_queued = 0
             return out
 
     def release(self, slot: int) -> None:
         """Return a slot to the pool (request finished — EOS, budget,
-        deadline, or error)."""
+        deadline, preemption, or error)."""
         with self._lock:
             if slot in self._free_slots:
                 raise ValueError(f"slot {slot} is already free")
+            tenant = self._slot_tenant.pop(slot, None)
+            if tenant is not None:
+                self._active[tenant] = max(self._active.get(tenant, 1) - 1, 0)
             self._free_slots.append(slot)
 
     def free_slot_count(self) -> int:
         with self._lock:
             return len(self._free_slots)
+
+
+# Back-compat: the single-tenant default config IS the old FIFO scheduler.
+FifoScheduler = TenantScheduler

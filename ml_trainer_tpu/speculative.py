@@ -57,8 +57,13 @@ from ml_trainer_tpu.generate import _COMPILED, _cache_shapes, _empty_cache
 
 def _set_index(cache, pos):
     """Broadcast the host-owned ``pos`` [B] vector into every per-row
-    index leaf (``cache_index``/``pos_index``, the only 1-D leaves); K/V
-    leaves pass through untouched."""
+    index leaf (``cache_index``/``pos_index``, the only 1-D leaves).
+    K/V leaves pass through untouched — contiguous ``[B, H, L, D]``
+    blocks and PAGED pool/page-table leaves alike (4-D pools and the 2-D
+    ``page_table``, serving/kv_pool.py), which is what lets one verify
+    program serve both cache layouts: in paged mode the verify window's
+    reads and writes resolve through the page table at the same ``pos``
+    offsets."""
     return jax.tree.map(
         lambda l: pos.astype(l.dtype) if l.ndim == 1 else l, cache
     )

@@ -24,6 +24,13 @@ loop as a fastlane leg:
    recorded best.  The baseline ratchets upward on every pass, so the
    gate tightens as the machine shows what it can do.
 
+A second leg (``gate_serve_replay``, skip with ``--skip-serve``) gates
+the PR6 paged serving subsystem on a short multi-tenant shared-prefix
+replay: byte identity and the zero-recompile pin are hard invariants,
+the paged-vs-contiguous ratio is the machine-independent floor, and the
+paged sustained tokens/s ratchets against the committed
+``docs/serving_replay_cpu.json`` artifact / this machine's baseline.
+
 Exit non-zero = regression.  Threshold override:
 ``ML_TRAINER_TPU_BENCH_GATE_THRESHOLD`` (fraction, e.g. ``0.15``).
 """
@@ -164,6 +171,85 @@ def evaluate(fresh: float, committed_ref, local_baseline,
     return result
 
 
+def committed_serve_reference(repo: str = REPO):
+    """Paged sustained tokens/s from the committed multi-tenant replay
+    artifact (docs/serving_replay_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "serving_replay_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = (data.get("paged") or {}).get("tokens_per_sec")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_serve_replay(threshold: float, backend: str, fp: str) -> dict:
+    """The paged-serving regression gate (PR6): a short multi-tenant
+    shared-prefix replay, paged vs contiguous, gated three ways —
+
+    1. **Invariants** (hard): greedy output byte-identical between the
+       engines, and no compiles during the paged timed pass.
+    2. **Paged-vs-contiguous ratio** (machine-independent): the paged
+       engine must hold >= ``1 - threshold`` of the contiguous rate on
+       the prefix-heavy trace (the committed artifact shows it WINNING;
+       the gate's looser bound just absorbs scheduler noise).
+    3. **Trajectory/local baseline** on the paged tokens/s, with the
+       same calibrate-then-ratchet fallback the parity gate uses.
+    """
+    import bench
+
+    result = bench.bench_serve_replay(
+        n_requests=24, mean_interarrival=0.004, spec_check=False,
+    )
+    out = {
+        "paged_tokens_per_sec": result["paged"]["tokens_per_sec"],
+        "contiguous_tokens_per_sec": result["contiguous"]["tokens_per_sec"],
+        "speedup": result["speedup"],
+        "ttft_p99_ratio": result["ttft_p99_ratio"],
+        "prefix_hit_rate": result["paged"]["prefix_hit_rate"],
+        "threshold": threshold,
+    }
+    if not result["greedy_byte_identical"]:
+        out.update(ok=False, decided_by="identity",
+                   error="paged output diverged from contiguous")
+        return out
+    if not result["paged"]["compiled_programs_constant"]:
+        out.update(ok=False, decided_by="zero_recompile",
+                   error="paged replay compiled new programs mid-traffic")
+        return out
+    if result["speedup"] < 1.0 - threshold:
+        out.update(
+            ok=False, decided_by="paged_vs_contiguous",
+            error=f"paged engine at {result['speedup']:.2f}x contiguous "
+            f"on the shared-prefix trace (floor {1.0 - threshold:.2f}x)",
+        )
+        return out
+    committed = committed_serve_reference()
+    serve_key = f"{backend}_serve_paged"
+    baseline = load_baseline(serve_key, fp)
+    decision = evaluate(
+        float(result["paged"]["tokens_per_sec"]),
+        committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            serve_key, fp,
+            max(float(result["paged"]["tokens_per_sec"]), baseline or 0.0),
+        )
+    elif "error" not in out:
+        out["error"] = (
+            f"paged {result['paged']['tokens_per_sec']} tokens/s is "
+            f">{threshold * 100:.0f}% below this machine's baseline "
+            f"{baseline}"
+        )
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--threshold", type=float, default=float(
@@ -173,6 +259,9 @@ def main() -> int:
     parser.add_argument("--reps", type=int, default=2,
                         help="measurement passes; best rate is compared "
                         "(the standard noise-floor trick)")
+    parser.add_argument("--skip-serve", action="store_true",
+                        help="skip the paged-serving replay gate (train "
+                        "parity gate only)")
     args = parser.parse_args()
 
     import jax
@@ -216,6 +305,20 @@ def main() -> int:
         f"{result['fresh_samples_per_sec']} samples/s",
         flush=True,
     )
+    if not args.skip_serve:
+        serve = gate_serve_replay(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_serve": serve}), flush=True)
+        if not serve["ok"]:
+            print(f"BENCH_GATE SERVE FAIL: {serve.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE SERVE OK ({serve['decided_by']}): paged "
+            f"{serve['paged_tokens_per_sec']} tokens/s "
+            f"({serve['speedup']}x contiguous, TTFT p99 ratio "
+            f"{serve['ttft_p99_ratio']})",
+            flush=True,
+        )
     return 0
 
 

@@ -6,10 +6,14 @@
 # resilience layer's env-var plumbing end to end, a telemetry smoke
 # leg (scripts/telemetry_smoke.py) covering the observability spine
 # (registry gauges, Prometheus exposition, spans, flight dumps, cluster
-# aggregation, run report, comm-bytes accounting), and a bench
+# aggregation, run report, comm-bytes accounting), a paged-serving
+# smoke leg (scripts/paged_serving_smoke.py) covering the PR6 paged KV
+# + prefix cache + preempt-requeue stack end to end, and a bench
 # regression gate (scripts/bench_gate.py) that fails on >10% samples/s
 # regression vs the committed BENCH trajectory / this machine's
-# calibrated baseline.
+# calibrated baseline — plus the paged-serving replay gate (byte
+# identity, zero-recompile, paged-vs-contiguous ratio, tokens/s
+# ratchet vs docs/serving_replay_cpu.json).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -30,12 +34,17 @@ echo "# telemetry smoke leg"
 timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
 telemetry_rc=$?
 [ $telemetry_rc -ne 0 ] && echo "# telemetry smoke FAILED (rc=$telemetry_rc)"
+echo "# paged serving smoke leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/paged_serving_smoke.py
+paged_rc=$?
+[ $paged_rc -ne 0 ] && echo "# paged serving smoke FAILED (rc=$paged_rc)"
 echo "# bench regression gate"
-timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 540 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 [ $rc -eq 0 ] && rc=$smoke_rc
 [ $rc -eq 0 ] && rc=$telemetry_rc
+[ $rc -eq 0 ] && rc=$paged_rc
 [ $rc -eq 0 ] && rc=$gate_rc
 exit $rc
