@@ -190,9 +190,12 @@ class MultiHeadAttention(nn.Module):
 
         Safety invariants (owned by the engine/pool, exploited here):
         page 0 is a TRASH page no live row maps to; inactive rows carry
-        an all-zero table, so their writes (positions clipped into the
-        table) land in trash instead of another row's pages, and
-        positions past a row's allocation also resolve to trash.
+        an all-zero table, so their writes land in trash instead of
+        another row's pages, and positions past a row's allocation also
+        resolve to trash.  Positions at or past ``max_len`` (a padded
+        continuation window hanging over the end of the sequence) route
+        to trash EXPLICITLY — clipping them into the last table slot
+        would scatter padding garbage over a full row's real tail K/V.
         """
         b, h, s, d = q.shape
         ps = self.kv_page_size
@@ -233,7 +236,11 @@ class MultiHeadAttention(nn.Module):
         positions = idx_vec[:, None] + jnp.arange(s)[None, :]       # [B, s]
         page_slot = jnp.clip(positions // ps, 0, P - 1)
         offs = positions % ps
-        page_ids = jnp.take_along_axis(table, page_slot, axis=1)    # [B, s]
+        page_ids = jnp.where(
+            positions < L,
+            jnp.take_along_axis(table, page_slot, axis=1),
+            0,
+        )                                                           # [B, s]
 
         def scatter(pool, t):  # t: [B, H, s, D] -> rows [B*s, H, D]
             rows = t.astype(pool.dtype).transpose(0, 2, 1, 3)

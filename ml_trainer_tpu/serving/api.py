@@ -135,6 +135,7 @@ class Server:
                  watchdog_timeout: Optional[float] = 60.0,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  prefix_cache: bool = True,
+                 prefix_scope: str = "tenant",
                  tenants: Optional[dict] = None,
                  max_preemptions: int = 8):
         """``watchdog_timeout``: seconds the engine loop may go without a
@@ -152,7 +153,12 @@ class Server:
         cache so shared prompt prefixes skip prefill; under page
         pressure long generations are preempted and re-queued (at most
         ``max_preemptions`` times each) with their generated tokens as
-        a resumable prefix.
+        a resumable prefix.  ``prefix_scope`` controls prefix sharing:
+        ``"tenant"`` (default) keeps each tenant's cached blocks in its
+        own namespace — cache residency is observable via TTFT and the
+        hit-rate metrics, so a shared trie is a cross-tenant content
+        side channel; ``"global"`` opts a trusted single-team
+        deployment back into cross-tenant sharing.
 
         ``tenants`` maps tenant name -> :class:`TenantConfig` (weight,
         max_active, max_queued); requests name their tenant at
@@ -162,7 +168,8 @@ class Server:
             model, variables, max_batch=max_batch, metrics=self.metrics,
             spec_k=spec_k, drafter=drafter, draft_variables=draft_variables,
             kv_page_size=kv_page_size, kv_pages=kv_pages,
-            prefix_cache=prefix_cache, max_preemptions=max_preemptions,
+            prefix_cache=prefix_cache, prefix_scope=prefix_scope,
+            max_preemptions=max_preemptions,
         )
         self.scheduler = TenantScheduler(
             max_batch, max_queue=max_queue, metrics=self.metrics,

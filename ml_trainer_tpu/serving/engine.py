@@ -59,7 +59,12 @@ bound covers every decode executable in the process.
   - a radix prefix cache maps shared prompt prefixes to already-filled
     refcounted pages, so a prefix hit skips their prefill entirely —
     only the unshared suffix runs (a ``serve_prefill_paged``
-    continuation window at the slot's dynamic offset);
+    continuation window at the slot's dynamic offset).  The cache is
+    NAMESPACED BY TENANT by default (``prefix_scope="tenant"``): cache
+    residency is observable (TTFT, hit-rate metrics), so a shared trie
+    would let one tenant probe another's prompt/generated content
+    block-by-block; ``prefix_scope="global"`` opts trusted deployments
+    back into cross-tenant sharing;
   - under page pressure the engine evicts cold prefix pages first, then
     PREEMPTS a victim request: its written pages are donated to the
     prefix cache, the rest freed, and the request re-queues with its
@@ -139,6 +144,7 @@ class SlotDecodeEngine:
                  ngram_n: int = 3,
                  kv_page_size: int = 0, kv_pages: int = 0,
                  prefix_cache: bool = True,
+                 prefix_scope: str = "tenant",
                  max_preemptions: int = 8):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -164,6 +170,12 @@ class SlotDecodeEngine:
         self.pool: Optional[KVPagePool] = None
         self._prefix: Optional[PrefixCache] = None
         self.max_preemptions = int(max_preemptions)
+        if prefix_scope not in ("tenant", "global"):
+            raise ValueError(
+                f"prefix_scope must be 'tenant' or 'global', got "
+                f"{prefix_scope!r}"
+            )
+        self.prefix_scope = prefix_scope
         self._preempted: List[Request] = []
         if self.paged:
             if self.max_len % self.kv_page_size:
@@ -491,6 +503,14 @@ class SlotDecodeEngine:
         self.cache = jax.tree.map(leaf, self.cache)
         self.pool.dirty = False
 
+    def _prefix_ns(self, req: Request) -> str:
+        """Prefix-cache namespace for ``req``: its tenant by default, so
+        whether a block is cached (observable via TTFT and the hit-rate
+        metrics) never leaks one tenant's prompt or generated content to
+        another; ``prefix_scope="global"`` opts a trusted deployment
+        back into one shared trie."""
+        return req.tenant if self.prefix_scope == "tenant" else ""
+
     def _page_row(self, slot: int) -> np.ndarray:
         row = np.zeros((self.pool.pages_per_slot,), np.int32)
         chain = self.pool.slot_pages[slot]
@@ -514,7 +534,9 @@ class SlotDecodeEngine:
                     np.asarray(req.prompt, np.int32).reshape(-1),
                     np.asarray(req.tokens, np.int32),
                 ])
-                self._prefix.insert(seq, chain[:blocks])
+                self._prefix.insert(
+                    seq, chain[:blocks], namespace=self._prefix_ns(req)
+                )
         self.pool.reset_slot(slot)
         self._push_kv_metrics()
 
@@ -653,8 +675,15 @@ class SlotDecodeEngine:
         c = 0
         if self.paged:
             if self._prefix is not None:
+                # A retry of a previously blocked ("no_memory") admission
+                # re-walks the trie but must not re-count stats or
+                # re-heat this request's prefix pages' LRU stamps — the
+                # serve loop retries every iteration under exactly the
+                # page pressure that makes eviction order matter.
                 shared, c = self._prefix.lookup(
-                    prompt, (p - 1) // self.kv_page_size
+                    prompt, (p - 1) // self.kv_page_size,
+                    namespace=self._prefix_ns(req),
+                    record=not req.kv_blocked,
                 )
                 req.prefix_hit_tokens = c
             # Cover the prompt plus the first decode window so a fresh
@@ -683,8 +712,10 @@ class SlotDecodeEngine:
                     )
                     return "finished"
                 self.metrics.record_admission_blocked()
+                req.kv_blocked = True
                 return "no_memory"
             self.pool.bind_slot(slot, shared + pages)
+            req.kv_blocked = False
 
         req.slot = slot
         req.state = "active"
@@ -719,6 +750,7 @@ class SlotDecodeEngine:
                 self._prefix.insert(
                     prompt,
                     self.pool.slot_pages[slot][: p // self.kv_page_size],
+                    namespace=self._prefix_ns(req),
                 )
             self._push_kv_metrics()
         token = int(tok0.reshape(-1)[0])

@@ -139,6 +139,11 @@ class Request:
     seq: Optional[int] = None
     preemptions: int = 0
     prefix_hit_tokens: int = 0
+    # Admission returned "no_memory" and the serve loop is retrying:
+    # retries skip prefix-cache stat/LRU accounting so a blocked request
+    # can't inflate hit rates or re-heat its own prefix pages while the
+    # engine is trying to evict its way out of the pressure.
+    kv_blocked: bool = False
     admitted_at: Optional[float] = None
     error: Optional[str] = None
     first_token_at: Optional[float] = None

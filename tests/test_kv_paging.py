@@ -107,6 +107,61 @@ def test_prefix_cache_radix_lookup_insert_evict():
         pool.release(more)
 
 
+def test_prefix_lookup_record_false_skips_stats_and_lru():
+    """An unrecorded lookup (the engine's retry of a blocked admission)
+    returns and pins the chain but neither bumps the hit-rate stats nor
+    re-heats the matched nodes — the older chain stays LRU."""
+    pool = KVPagePool(num_pages=17, page_size=4, max_len=64, max_batch=2)
+    cache = PrefixCache(pool)
+    old = np.arange(8, dtype=np.int32)               # 2 blocks
+    new = np.arange(100, 108, dtype=np.int32)        # 2 blocks, younger
+    p_old = pool.allocate(2)
+    cache.insert(old, p_old)
+    p_new = pool.allocate(2)
+    cache.insert(new, p_new)
+    pool.release(p_old)
+    pool.release(p_new)                              # cache-resident only
+    got, n = cache.lookup(old, 2, record=False)
+    assert got == p_old and n == 8
+    pool.release(got)
+    assert cache.hits == 0 and cache.misses == 0
+    assert cache.hit_tokens == 0 and cache.lookup_tokens == 0
+    # The unrecorded walk did not refresh `old`: it is still the LRU
+    # chain, so eviction takes it first and leaves `new` resident.
+    assert cache.evict(2) == 2
+    assert cache.lookup(old, 2, record=False)[1] == 0
+    got2, n2 = cache.lookup(new, 2, record=False)
+    assert n2 == 8
+    pool.release(got2)
+
+
+def test_prefix_cache_deep_chain_evicts_in_one_call():
+    """A deep resident chain drains fully in ONE evict() call (the heap
+    pushes each parent as its child is dropped)."""
+    pool = KVPagePool(num_pages=17, page_size=4, max_len=64, max_batch=2)
+    cache = PrefixCache(pool)
+    toks = np.arange(24, dtype=np.int32)             # 6-block chain
+    pages = pool.allocate(6)
+    assert cache.insert(toks, pages) == 6
+    pool.release(pages)                              # cache-resident only
+    assert cache.evict(6) == 6
+    assert len(cache) == 0 and pool.free_count() == 16
+
+
+def test_prefix_cache_namespaces_do_not_cross():
+    pool = KVPagePool(num_pages=17, page_size=4, max_len=64, max_batch=2)
+    cache = PrefixCache(pool)
+    toks = np.arange(8, dtype=np.int32)
+    pages = pool.allocate(2)
+    cache.insert(toks, pages, namespace="tenant-a")
+    got_b, n_b = cache.lookup(toks, 2, namespace="tenant-b")
+    assert got_b == [] and n_b == 0
+    got_a, n_a = cache.lookup(toks, 2, namespace="tenant-a")
+    assert got_a == pages and n_a == 8
+    pool.release(got_a)
+    pool.release(pages)
+
+
 def test_tenant_scheduler_weighted_admission_quotas_priorities():
     sched = TenantScheduler(
         max_batch=8, max_queue=16,
@@ -311,6 +366,109 @@ def test_prefix_cache_eviction_keeps_outputs_correct(model_and_vars):
         == snap["kv_pages_total"]
     # Whatever is still resident is prefix-cache pages only (<= pool).
     assert snap["kv_pages_used"] <= 10
+
+
+def test_continuation_window_past_max_len_writes_trash_not_tail(
+    model_and_vars
+):
+    """A prefix hit whose pow2-padded suffix window hangs past max_len
+    (c=40, su=18 -> bucket 32, window positions 40..71 on max_len=64)
+    while the slot's chain fills EVERY page-table entry: the overflow
+    padding writes must land in trash — clipping them into the last
+    table slot scatters garbage over the row's REAL tail K/V (positions
+    56..63 here, including prompt tokens 56/57; duplicate scatter
+    indices, last-write-wins on CPU).  Output argmax can mask that on a
+    tiny model, so the last page's K/V is compared against a contiguous
+    forward of the same prompt — tight tolerance (the reference is a
+    differently-shaped program, so ~1e-6 reduction-order noise is
+    expected; the clobber is O(1))."""
+    from jax import tree_util
+    import jax.numpy as jnp
+
+    from ml_trainer_tpu.generate import _cache_shapes, _empty_cache
+    from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+
+    model, variables = model_and_vars
+    first = _prompt(50, 5 * PS + 1)                        # caches 5 blocks
+    second = np.concatenate(
+        [first[:5 * PS], _prompt(51, 18)]                  # p=58: needs all
+    ).astype(np.int32)                                     # 8 slot pages
+    eng = SlotDecodeEngine(model, variables, max_batch=2, kv_page_size=PS)
+    r1 = Request(prompt=first, max_new_tokens=6)
+    if eng.admit(r1, 0) == "active":
+        while 0 in eng._active:
+            eng.step()
+    r2 = Request(prompt=second, max_new_tokens=4)
+    assert eng.admit(r2, 0) == "active"
+    assert r2.prefix_hit_tokens == 5 * PS                  # continuation ran
+    chain = eng.pool.slot_pages[0]
+    assert len(chain) == eng.pool.pages_per_slot           # table row full
+    # Contiguous reference: one decode-mode forward over the whole
+    # prompt fills positions 0..57 of a fresh contiguous cache.
+    dm = model.clone(decode=True)
+    _, mut = dm.apply(
+        {"params": eng.params,
+         "cache": _empty_cache(_cache_shapes(dm, 1, jnp.int32))},
+        second[None, :], train=False, mutable=["cache"],
+    )
+    ref = {
+        tuple(str(k) for k in path): leaf
+        for path, leaf in tree_util.tree_flatten_with_path(
+            mut["cache"]
+        )[0]
+    }
+    paged = {
+        tuple(str(k) for k in path): leaf
+        for path, leaf in tree_util.tree_flatten_with_path(eng.cache)[0]
+    }
+    compared = 0
+    for path, ref_leaf in ref.items():
+        if ref_leaf.ndim != 4:
+            continue
+        # Last page, offsets 0..1 hold logical positions 56..57 — the
+        # real prompt tail the clipped overflow would have clobbered.
+        got = np.asarray(paged[path][chain[-1], :, 0:2, :])
+        want = np.asarray(ref_leaf[0, :, 56:58, :])
+        np.testing.assert_allclose(
+            got, want, rtol=1e-3, atol=1e-4, err_msg=str(path)
+        )
+        compared += 1
+    assert compared >= 2  # cached_key + cached_value, every layer
+    # And end-to-end: the continuation-admitted request still matches
+    # standalone generate() byte-for-byte.
+    ref2 = np.asarray(generate(model, variables, second[None], 4))[0]
+    while 0 in eng._active:
+        eng.step()
+    np.testing.assert_array_equal(
+        np.concatenate([second, np.asarray(r2.tokens, np.int32)]), ref2
+    )
+
+
+def test_prefix_cache_is_tenant_scoped(model_and_vars):
+    """Tenant B never hits tenant A's cached blocks (the cross-tenant
+    residency probe is closed); A keeps hitting its own, and
+    prefix_scope='global' restores the old shared behavior."""
+    model, variables = model_and_vars
+    prompt = _prompt(60, 2 * PS + 2)
+    ref = np.asarray(generate(model, variables, prompt[None], 4))[0]
+    with Server(model, variables, max_batch=2, kv_page_size=PS) as server:
+        sA = server.submit(prompt, 4, tenant="A")
+        np.testing.assert_array_equal(sA.result(timeout=120), ref)
+        sB = server.submit(prompt, 4, tenant="B")
+        np.testing.assert_array_equal(sB.result(timeout=120), ref)
+        sA2 = server.submit(prompt, 4, tenant="A")
+        np.testing.assert_array_equal(sA2.result(timeout=120), ref)
+    assert sB.request.prefix_hit_tokens == 0
+    assert sA2.request.prefix_hit_tokens == 2 * PS
+    with Server(model, variables, max_batch=2, kv_page_size=PS,
+                prefix_scope="global") as server:
+        server.submit(prompt, 4, tenant="A").result(timeout=120)
+        sB = server.submit(prompt, 4, tenant="B")
+        np.testing.assert_array_equal(sB.result(timeout=120), ref)
+    assert sB.request.prefix_hit_tokens == 2 * PS
+    with pytest.raises(ValueError, match="prefix_scope"):
+        Server(model, variables, max_batch=1, kv_page_size=PS,
+               prefix_scope="bogus")
 
 
 # --------------------------------------------- preemption and requeue
