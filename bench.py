@@ -1077,6 +1077,177 @@ def bench_telemetry(batch_size=32, reps=3, warmup=5, iters=40):
     }
 
 
+def bench_mixed(n_devices=8, batch_size=16, seq_len=32, iters=8, warmup=2,
+                reps=2, out_path=None):
+    """Mixed-precision / sharded-update matrix on a virtual pure-DP mesh
+    (the ``dryrun_multichip`` style: CPU with forced host devices, same
+    compiled collectives as the chip):
+
+        {fp32, bf16} x {fused-psum, bucketed reduce-scatter + sharded
+        update}
+
+    Each row is the REAL ``Trainer`` train step (the exact code path of
+    training runs) on pre-materialized device batches: steady-state step
+    time, per-op comm bytes (analytic, trace-time), the per-bucket
+    reduce-scatter/all-gather breakdown for the sharded rows, and the
+    compiled-program-count pin.  Needs ``n_devices`` local devices; when
+    fewer exist the measurement respawns itself in a subprocess with
+    ``--xla_force_host_platform_device_count`` (the backend's device
+    count is fixed at init)."""
+    import os
+    import subprocess
+
+    if len(jax.devices()) < n_devices:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+        env["ML_TRAINER_TPU_MIXED_CHILD"] = "1"
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mixed",
+             "--mixed-devices", str(n_devices)],
+            env=env, capture_output=True, text=True, timeout=1500,
+        )
+        result = None
+        for line in r.stdout.splitlines():
+            print(line, flush=True)  # re-surface the child's rows
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line).get("mixed")
+                except ValueError:
+                    pass
+        if r.returncode != 0 or result is None:
+            tail = (r.stderr or "").strip().splitlines()
+            return {"error": f"mixed worker failed (rc={r.returncode}): "
+                             f"{tail[-1] if tail else 'no stderr'}"}
+        if out_path:
+            _write_mixed_artifact(result, out_path)
+        return result
+
+    from ml_trainer_tpu import Trainer
+    from ml_trainer_tpu.data import SyntheticTokens, prefetch_to_device
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.parallel.comm_stats import (
+        comm_bucket_bytes,
+        comm_bytes,
+        reset_comm_stats,
+    )
+
+    ds = SyntheticTokens(
+        size=max(batch_size * 8, 64), seq_len=seq_len, vocab_size=256,
+        seed=0,
+    )
+    rows = []
+    for precision in ("fp32", "bf16"):
+        for dp_update in ("fused", "sharded"):
+            reset_comm_stats()
+            trainer = Trainer(
+                get_model("gpt2_tiny", vocab_size=256),
+                datasets=(ds, ds), epochs=1, batch_size=batch_size,
+                model_dir=f"/tmp/bench_mixed_{precision}_{dp_update}",
+                mesh_shape={"data": n_devices}, optimizer="adamw",
+                metric=None, lr=1e-3, precision=precision,
+                dp_update=dp_update, bucket_mb=0.25,
+            )
+            batches = [
+                (x, y, jnp.asarray(1.0, jnp.float32))
+                for _, (x, y) in zip(
+                    range(4),
+                    prefetch_to_device(
+                        trainer.train_loader, size=2,
+                        sharding=trainer._batch_sharding,
+                    ),
+                )
+            ]
+            # One probed step first: finite-loss evidence (the state it
+            # returns replaces the donated input).
+            state, loss, *_ = trainer._train_step(
+                trainer.state, *batches[0]
+            )
+            loss = float(loss)
+            best = 0.0
+            for _ in range(reps):
+                r, state = _steady_state_rate(
+                    trainer._train_step, state, batches,
+                    warmup=warmup, iters=iters,
+                )
+                best = max(best, r)
+            comm = {k: round(v, 1) for k, v in comm_bytes().items()}
+            buckets = {
+                op: {b: round(v, 1) for b, v in bs.items()}
+                for op, bs in comm_bucket_bytes().items()
+            }
+            row = {
+                "precision": precision,
+                "dp_update": dp_update,
+                "samples_per_sec": round(best * batch_size, 1),
+                "step_ms": round(1e3 / best, 3) if best else None,
+                "loss": round(loss, 4),
+                "loss_finite": bool(np.isfinite(loss)),
+                "comm_bytes": comm,
+                "comm_buckets": buckets,
+                "compiled_programs_constant":
+                    trainer._train_step._cache_size() == 1,
+            }
+            if dp_update == "sharded":
+                row["n_buckets"] = len(trainer._bucket_plan.buckets)
+                row["overlap_fraction"] = round(
+                    trainer._bucket_plan.overlap_fraction, 4
+                )
+            rows.append(row)
+            print(
+                f"# mixed {precision:>4}/{dp_update:<7} "
+                f"{row['samples_per_sec']:>8,.1f} samples/s  "
+                f"step {row['step_ms']:.2f} ms  loss {loss:.4f}  "
+                f"comm {sum(comm.values()):,.0f} B/step", flush=True,
+            )
+
+    def rate(precision, dp_update):
+        for row in rows:
+            if (row["precision"], row["dp_update"]) == (precision, dp_update):
+                return row["samples_per_sec"]
+        return 0.0
+
+    result = {
+        "model": "gpt2_tiny(vocab=256)",
+        "n_devices": n_devices,
+        "batch_size": batch_size,
+        "seq_len": seq_len,
+        "backend": jax.default_backend(),
+        "rows": rows,
+        # Headline ratios: the sharded-update win at each precision, and
+        # the full-stack bf16+sharded vs the fp32 fused baseline.
+        "sharded_vs_fused_fp32": round(
+            rate("fp32", "sharded") / max(rate("fp32", "fused"), 1e-9), 3
+        ),
+        "sharded_vs_fused_bf16": round(
+            rate("bf16", "sharded") / max(rate("bf16", "fused"), 1e-9), 3
+        ),
+        "bf16_sharded_vs_fp32_fused": round(
+            rate("bf16", "sharded") / max(rate("fp32", "fused"), 1e-9), 3
+        ),
+    }
+    if out_path:
+        _write_mixed_artifact(result, out_path)
+    return result
+
+
+def _write_mixed_artifact(result, out_path) -> None:
+    import os
+
+    payload = dict(result)
+    payload["generated_by"] = "bench.py --mixed"
+    payload["date"] = _utcnow()
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fp:
+        json.dump(payload, fp, indent=1)
+    os.replace(tmp, out_path)
+    print(f"# mixed artifact -> {out_path}", flush=True)
+
+
 def bench_extended():
     """North-star table, one model per SUBPROCESS so a tunnel hang in any
     single model costs its per-model timeout, not the whole table (round
@@ -1206,6 +1377,15 @@ def main():
                         "80%%-shared-prefix Poisson trace; writes the "
                         "docs/serving_replay_cpu.json artifact "
                         "(gpt2_tiny; CPU-safe)")
+    parser.add_argument("--mixed", action="store_true",
+                        help="run only the mixed-precision / sharded-update "
+                        "matrix: {fp32,bf16} x {fused-psum, bucketed "
+                        "reduce-scatter + sharded update} step time and "
+                        "comm bytes on a virtual pure-DP mesh (the "
+                        "dryrun_multichip style; writes "
+                        "docs/mixed_precision_cpu.json; CPU-safe)")
+    parser.add_argument("--mixed-devices", type=int, default=8,
+                        help="virtual device count for --mixed (default 8)")
     parser.add_argument("--assume-up", action="store_true",
                         help="skip the --one pre-probe (used by --extended, "
                         "whose parent just probed — a second throwaway "
@@ -1278,6 +1458,22 @@ def main():
         )
         result = bench_serve_replay(out_path=out)
         print(json.dumps({"serve_replay": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.mixed:
+        # Mixed-precision / sharded-update matrix on virtual devices.
+        # The respawned child (env marker) must not write the artifact —
+        # its parent does, after validating the child's JSON.
+        import os as _os
+
+        child = _os.environ.get("ML_TRAINER_TPU_MIXED_CHILD") == "1"
+        out = None if child else _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "mixed_precision_cpu.json",
+        )
+        result = bench_mixed(n_devices=args.mixed_devices, out_path=out)
+        print(json.dumps({"mixed": result}), flush=True)
         if result.get("error"):
             sys.exit(1)
         return

@@ -722,23 +722,31 @@ def _reconcile_ema(state_template: Any, saved: Any) -> Any:
 
 
 def _reconcile_guard_counters(state_template: Any, saved: Any) -> Any:
-    """Make checkpoints portable across the nonfinite-guard counters'
-    addition to TrainState (skipped_steps / bad_streak).  Pre-counter
-    checkpoints restoring into a counter-carrying template get zeros;
-    counter-carrying checkpoints restoring into a counter-less template
-    (states built outside the Trainer) drop them."""
+    """Make checkpoints portable across the scalar-counter additions to
+    TrainState (skipped_steps / bad_streak, and the mixed-precision
+    loss_scale / good_steps).  Pre-counter checkpoints restoring into a
+    counter-carrying template get neutral defaults (the trainer re-seeds
+    a zero loss_scale to its configured initial scale); counter-carrying
+    checkpoints restoring into a counter-less template (states built
+    outside the Trainer, or an fp32 resume of a bf16 run) drop them."""
     if not isinstance(saved, dict):
         return saved
     tpl = serialization.to_state_dict(state_template)
     if not isinstance(tpl, dict):
         return saved
-    for key in ("skipped_steps", "bad_streak"):
+    defaults = {
+        "skipped_steps": lambda: np.zeros((), np.int32),
+        "bad_streak": lambda: np.zeros((), np.int32),
+        "loss_scale": lambda: np.zeros((), np.float32),
+        "good_steps": lambda: np.zeros((), np.int32),
+    }
+    for key, default in defaults.items():
         if key not in tpl:
             continue
         want = tpl[key] is not None
         if want and saved.get(key) is None:
             saved = dict(saved)
-            saved[key] = np.zeros((), np.int32)
+            saved[key] = default()
         elif not want and key in saved and saved[key] is not None:
             saved = dict(saved)
             saved[key] = None

@@ -46,13 +46,36 @@ def _load_families() -> None:
 
 
 def get_model(name: str, **kwargs) -> nn.Module:
+    """Build a registered model.  ``precision=`` (a policy name like
+    ``'bf16'`` or a ``precision.Precision``) threads the policy's compute
+    dtype onto the module's ``dtype`` knob for the families that carry
+    one (the transformer zoo computes activations in ``dtype`` while
+    params stay fp32 — exactly the mixed-precision split); families
+    without a ``dtype`` field (mlmodel/resnet) ignore it here and rely
+    on the Trainer's generic cast-at-apply instead."""
     _load_families()
+    precision = kwargs.pop("precision", None)
+    if precision is not None and "dtype" not in kwargs:
+        from ml_trainer_tpu.precision import resolve_precision
+
+        policy = resolve_precision(precision)
+        if policy.active:
+            kwargs["dtype"] = policy.compute
     try:
-        return MODELS[name](**kwargs)
+        factory = MODELS[name]
     except KeyError:
         raise ValueError(
             f"Unknown model {name!r}; expected one of {sorted(MODELS)}"
         ) from None
+    try:
+        return factory(**kwargs)
+    except TypeError:
+        if "dtype" in kwargs and precision is not None:
+            # Family without a dtype knob: drop the threaded compute dtype
+            # (the Trainer-level cast covers these models).
+            kwargs.pop("dtype")
+            return factory(**kwargs)
+        raise
 
 
 def available_models():

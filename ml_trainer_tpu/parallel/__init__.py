@@ -25,8 +25,12 @@ from ml_trainer_tpu.parallel.distributed import (
 )
 from ml_trainer_tpu.parallel.sharding import (
     batch_sharding,
+    bucketed_all_gather,
+    bucketed_reduce_scatter,
     fit_sharding_to_rank,
+    GradBucketPlan,
     place_tree,
+    plan_grad_buckets,
     replicated,
     shard_opt_state,
     shard_params,
@@ -62,8 +66,12 @@ __all__ = [
     "process_count",
     "process_index",
     "batch_sharding",
+    "bucketed_all_gather",
+    "bucketed_reduce_scatter",
     "fit_sharding_to_rank",
+    "GradBucketPlan",
     "place_tree",
+    "plan_grad_buckets",
     "replicated",
     "shard_opt_state",
     "shard_params",

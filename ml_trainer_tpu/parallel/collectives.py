@@ -43,13 +43,20 @@ def pmean(x, axis: AxisName):
     return lax.pmean(x, axis)
 
 
-def all_gather(x, axis: AxisName, *, axis_index: int = 0, tiled: bool = True):
-    _account("all_gather", x, axis)
+def all_gather(x, axis: AxisName, *, axis_index: int = 0, tiled: bool = True,
+               bucket: str = None):
+    """``bucket`` labels this call in the per-bucket comm breakdown
+    (``comm_bucket_bytes_total{op=,bucket=}``) — the bucketed weight
+    all-gather of the sharded update path tags each bucket's traffic."""
+    _account("all_gather", x, axis, bucket=bucket)
     return lax.all_gather(x, axis, axis=axis_index, tiled=tiled)
 
 
-def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0):
-    _account("reduce_scatter", x, axis)
+def reduce_scatter(x, axis: AxisName, *, scatter_axis: int = 0,
+                   bucket: str = None):
+    """``bucket`` labels this call in the per-bucket comm breakdown — the
+    overlapped backward issues one reduce-scatter per gradient bucket."""
+    _account("reduce_scatter", x, axis, bucket=bucket)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
 
 

@@ -49,6 +49,12 @@ import numpy as np
 _lock = threading.Lock()
 _bytes: Dict[str, float] = {}
 _calls: Dict[str, int] = {}
+# Per-bucket breakdown for bucketed collectives (the overlapped
+# reduce-scatter backward issues one collective per gradient bucket;
+# attributing bytes per bucket is how a mis-sized bucket plan shows up
+# on /metrics).  Keyed (op, bucket-label); mirrored into the registry as
+# ``comm_bucket_bytes_total{op=,bucket=}``.
+_bucket_bytes: Dict[Tuple[str, str], float] = {}
 
 _FACTORS = {
     "psum": lambda size, n: 2.0 * size * (n - 1) / n,
@@ -85,15 +91,25 @@ def _tree_bytes(x) -> int:
     return total
 
 
-def record_collective(op: str, n_bytes: float, calls: int = 1) -> None:
+def record_collective(op: str, n_bytes: float, calls: int = 1,
+                      bucket: str = None) -> None:
     """Accumulate ``n_bytes`` against ``op`` and mirror the running totals
     into the default registry (``comm_bytes_total{op=...}`` /
     ``comm_calls_total{op=...}`` gauges — gauges, not counters, because
-    ``reset_comm_stats`` legally zeroes them between bench legs)."""
+    ``reset_comm_stats`` legally zeroes them between bench legs).  With
+    ``bucket`` set the bytes additionally land in the per-bucket
+    breakdown (``comm_bucket_bytes_total{op=,bucket=}``) — the op totals
+    always include bucketed traffic, so the breakdown is a view, not a
+    second ledger."""
+    bb = None
     with _lock:
         _bytes[op] = _bytes.get(op, 0.0) + float(n_bytes)
         _calls[op] = _calls.get(op, 0) + int(calls)
         b, c = _bytes[op], _calls[op]
+        if bucket is not None:
+            key = (op, str(bucket))
+            _bucket_bytes[key] = _bucket_bytes.get(key, 0.0) + float(n_bytes)
+            bb = _bucket_bytes[key]
     try:
         from ml_trainer_tpu.telemetry.registry import default_registry
 
@@ -108,11 +124,18 @@ def record_collective(op: str, n_bytes: float, calls: int = 1) -> None:
             "traced explicit-collective call sites",
             ("op",),
         ).labels(op=op).set(c)
+        if bb is not None:
+            r.gauge(
+                "comm_bucket_bytes_total",
+                "per-bucket analytic bytes of bucketed collectives "
+                "(the overlapped reduce-scatter backward)",
+                ("op", "bucket"),
+            ).labels(op=op, bucket=str(bucket)).set(bb)
     except Exception:  # registry trouble must never break a trace
         pass
 
 
-def account(op: str, x, axis, times: int = 1) -> None:
+def account(op: str, x, axis, times: int = 1, bucket: str = None) -> None:
     """Trace-time accounting hook: compute the analytic byte count of one
     ``op`` over ``axis`` for input ``x`` and record it ``times`` times.
     ``times`` exists for collectives traced once inside a ``scan`` /
@@ -132,7 +155,7 @@ def account(op: str, x, axis, times: int = 1) -> None:
             n = int(_axis_size(axis))
         record_collective(
             op, collective_bytes(op, _tree_bytes(x), n) * int(times),
-            calls=int(times),
+            calls=int(times), bucket=bucket,
         )
     except Exception:
         pass
@@ -147,6 +170,16 @@ def comm_bytes() -> Dict[str, float]:
 def comm_calls() -> Dict[str, int]:
     with _lock:
         return dict(_calls)
+
+
+def comm_bucket_bytes() -> Dict[str, Dict[str, float]]:
+    """Per-bucket cumulative analytic bytes, grouped by op:
+    ``{op: {bucket: bytes}}`` (copy; empty when nothing bucketed ran)."""
+    with _lock:
+        out: Dict[str, Dict[str, float]] = {}
+        for (op, bucket), b in _bucket_bytes.items():
+            out.setdefault(op, {})[bucket] = b
+        return out
 
 
 def comm_bytes_total() -> float:
@@ -172,8 +205,10 @@ def reset_comm_stats() -> None:
     the multichip dryrun reset between measurements."""
     with _lock:
         ops: Tuple[str, ...] = tuple(_bytes)
+        buckets = tuple(_bucket_bytes)
         _bytes.clear()
         _calls.clear()
+        _bucket_bytes.clear()
     try:
         from ml_trainer_tpu.telemetry.registry import default_registry
 
@@ -181,5 +216,9 @@ def reset_comm_stats() -> None:
         for op in ops:
             r.gauge("comm_bytes_total", "", ("op",)).labels(op=op).set(0.0)
             r.gauge("comm_calls_total", "", ("op",)).labels(op=op).set(0.0)
+        for op, bucket in buckets:
+            r.gauge(
+                "comm_bucket_bytes_total", "", ("op", "bucket")
+            ).labels(op=op, bucket=bucket).set(0.0)
     except Exception:
         pass

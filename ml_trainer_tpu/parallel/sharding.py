@@ -16,9 +16,10 @@ with explicit ``NamedSharding`` annotations:
 from __future__ import annotations
 
 import re
-from typing import List, Optional, Sequence, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 Rules = Sequence[Tuple[str, P]]
@@ -167,6 +168,139 @@ def shard_opt_state(opt_state, mesh: Mesh, axis: str = "data"):
         return jax.device_put(leaf, NamedSharding(mesh, P(axis)))
 
     return jax.tree.map(place, opt_state)
+
+
+class GradBucketPlan(NamedTuple):
+    """Static plan for the bucketed reduce-scatter backward + sharded
+    weight update (the cross-replica weight-update sharding of arXiv
+    2004.13336, bucketed the way DDP's reducer buckets its all-reduces so
+    communication can hide under remaining backward compute).
+
+    ``sharded[i]`` says whether param leaf ``i`` (tree-flatten order)
+    takes the reduce-scatter/sharded-update path (dim 0 divides the axis)
+    or stays on the replicated psum path.  ``buckets`` lists leaf indices
+    grouped into size-bounded buckets in REVERSE flatten order — the
+    backward produces last-layer gradients first, so reverse forward
+    order approximates production order and each bucket's collective has
+    its inputs ready while earlier layers' gradients are still being
+    computed (the XLA latency-hiding scheduler can then overlap them; a
+    single tail psum has nothing to overlap with).
+    """
+
+    n: int
+    sharded: Tuple[bool, ...]
+    buckets: Tuple[Tuple[int, ...], ...]
+    bucket_bytes: Tuple[int, ...]
+    overlap_fraction: float
+
+
+def plan_grad_buckets(tree, n: int,
+                      bucket_bytes: int = 4 * 2 ** 20) -> GradBucketPlan:
+    """Partition ``tree``'s leaves (shape/dtype carriers — ``eval_shape``
+    output works) into reduce-scatter buckets of at most ``bucket_bytes``
+    each.  The shard rule matches :func:`zero1_opt_shardings` exactly, so
+    gradient shards, parameter shards and ZeRO-1 moment shards line up
+    leaf-for-leaf.  ``overlap_fraction`` is the analytic share of
+    reduce-scatter bytes whose collectives can hide under remaining
+    backward compute — everything but the final bucket, whose inputs
+    (the earliest layers' grads) are only ready when the backward ends."""
+    if n < 1:
+        raise ValueError(f"axis size must be >= 1, got {n}")
+    leaves = jax.tree.leaves(tree)
+    sharded = tuple(
+        len(getattr(leaf, "shape", ())) > 0
+        and leaf.shape[0] > 0
+        and leaf.shape[0] % n == 0
+        for leaf in leaves
+    )
+    nbytes = [
+        int(np.prod(leaf.shape, initial=1, dtype=np.int64))
+        * np.dtype(leaf.dtype).itemsize
+        for leaf in leaves
+    ]
+    buckets: List[Tuple[int, ...]] = []
+    sizes: List[int] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(leaves))):
+        if not sharded[i]:
+            continue
+        if cur and cur_bytes + nbytes[i] > bucket_bytes:
+            buckets.append(tuple(cur))
+            sizes.append(cur_bytes)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes[i]
+    if cur:
+        buckets.append(tuple(cur))
+        sizes.append(cur_bytes)
+    total = float(sum(sizes))
+    overlap = 1.0 - sizes[-1] / total if len(sizes) > 1 and total else 0.0
+    return GradBucketPlan(
+        n=int(n),
+        sharded=sharded,
+        buckets=tuple(buckets),
+        bucket_bytes=tuple(sizes),
+        overlap_fraction=overlap,
+    )
+
+
+def bucketed_reduce_scatter(leaves, plan: GradBucketPlan,
+                            axis: str = "data"):
+    """Inside a ``shard_map`` body: reduce-scatter each bucket of local
+    (per-replica, unreduced) gradient leaves in ONE collective per bucket
+    via the instrumented wrapper, returning the list with every sharded
+    leaf replaced by this replica's dim-0 shard (``d0/n``-sized).
+    Replicated leaves pass through untouched — the caller psums those.
+
+    Each leaf reshapes to ``(n, d0/n * rest)`` so the concatenated bucket
+    scatters along dim 0: replica ``j`` receives exactly the rows the
+    ZeRO-1 ``P(axis)`` placement assigns it, summed across replicas."""
+    import jax.numpy as jnp
+
+    from ml_trainer_tpu.parallel import collectives
+
+    out = list(leaves)
+    for bi, idxs in enumerate(plan.buckets):
+        parts = [leaves[i].reshape(plan.n, -1) for i in idxs]
+        widths = [p.shape[1] for p in parts]
+        flat = collectives.reduce_scatter(
+            jnp.concatenate(parts, axis=1), axis, scatter_axis=0,
+            bucket=f"b{bi}",
+        ).reshape(-1)
+        off = 0
+        for i, w in zip(idxs, widths):
+            shape = (leaves[i].shape[0] // plan.n,) + tuple(
+                leaves[i].shape[1:]
+            )
+            out[i] = flat[off:off + w].reshape(shape)
+            off += w
+    return out
+
+
+def bucketed_all_gather(local_leaves, plan: GradBucketPlan, full_shapes,
+                        axis: str = "data"):
+    """Inverse of :func:`bucketed_reduce_scatter` for the fresh weights:
+    all-gather each bucket of locally-updated parameter shards in one
+    collective, returning the list with every sharded leaf restored to
+    its full (replicated) shape.  Gathers untiled — device ``j``'s chunk
+    lands at row ``j``, which is exactly the dim-0 block order."""
+    import jax.numpy as jnp
+
+    from ml_trainer_tpu.parallel import collectives
+
+    out = list(local_leaves)
+    for bi, idxs in enumerate(plan.buckets):
+        parts = [local_leaves[i].reshape(-1) for i in idxs]
+        widths = [p.shape[0] for p in parts]
+        gathered = collectives.all_gather(
+            jnp.concatenate(parts), axis, tiled=False, bucket=f"b{bi}"
+        )  # [n, sum(widths)]
+        off = 0
+        for i, w in zip(idxs, widths):
+            out[i] = gathered[:, off:off + w].reshape(full_shapes[i])
+            off += w
+    return out
 
 
 def zero1_opt_shardings(opt_shapes, mesh: Mesh, axis: str = "data"):

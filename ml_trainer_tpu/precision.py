@@ -1,0 +1,170 @@
+"""Mixed-precision policy: bf16 compute against fp32 master params.
+
+The reference trains everything in fp32 (torch default); on TPU the MXU's
+bf16 throughput is ~2x fp32, so the standard recipe (arXiv 1710.03740,
+every production TPU trainer since) is a *policy* of three dtypes:
+
+* ``compute`` — forward/backward activations and matmuls (bf16);
+* ``params``  — the master copy the optimizer updates (fp32, lives in
+  ``TrainState``; cast to ``compute`` once per step at the top of the
+  loss function, so gradients come back fp32 through the cast's vjp);
+* ``output``  — logits/loss as consumed by the criterion and metrics
+  (fp32: a softmax cross-entropy over bf16 logits loses ulp exactly
+  where the loss signal lives).
+
+bf16 keeps fp32's 8-bit exponent, so unlike fp16 it rarely *needs* loss
+scaling — but small gradients still flush to zero in bf16 backward
+accumulation, and the policy composes with the trainer's existing
+non-finite guard, so :class:`LossScaleConfig` implements the standard
+dynamic scheme anyway (scale the loss up, unscale the grads, halve on
+overflow WITHOUT burning a rollback streak, grow back after a streak of
+healthy steps).  ``Trainer(precision='bf16')`` turns the whole stack on;
+``Trainer(precision='bf16', loss_scale=None)`` keeps bare bf16.
+
+Threading (the three layers the policy touches):
+
+* ``models/registry.py`` — ``get_model(name, precision=...)`` maps the
+  policy's compute dtype onto the module's ``dtype`` knob for the
+  families that carry one (the transformer zoo), so module-internal
+  casts agree with the trainer's;
+* ``train_state.py`` — ``loss_scale`` / ``good_steps`` ride in the
+  state so the compiled step maintains them with no host sync;
+* ``trainer.py`` — casts params/batch to ``compute`` inside the loss
+  function, the criterion back at ``output``, and folds the
+  scale-backoff/growth arithmetic into the non-finite guard.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+# Dynamic loss-scaling defaults (the torch.cuda.amp / t5x constants,
+# adjusted for bf16's wide exponent: a smaller initial scale converges to
+# steady state faster and overflow is rare anyway).
+DEFAULT_INIT_SCALE = 2.0 ** 15
+MIN_SCALE = 1.0
+MAX_SCALE = 2.0 ** 24
+GROWTH_FACTOR = 2.0
+BACKOFF_FACTOR = 0.5
+GROWTH_INTERVAL = 2000  # consecutive finite steps before the scale doubles
+
+
+@dataclasses.dataclass(frozen=True)
+class Precision:
+    """The (compute, params, output) dtype triple of one training run."""
+
+    compute: Any = jnp.float32
+    params: Any = jnp.float32
+    output: Any = jnp.float32
+
+    @property
+    def active(self) -> bool:
+        """True when compute differs from the fp32 master dtype — the only
+        case the trainer's cast machinery engages (fp32 stays the exact
+        pre-policy program, bit-identical)."""
+        return jnp.dtype(self.compute) != jnp.dtype(self.params)
+
+    def label(self) -> str:
+        return jnp.dtype(self.compute).name
+
+
+# Named policies — the strings Trainer(precision=...) and
+# get_model(precision=...) accept.
+POLICIES = {
+    "fp32": Precision(),
+    "float32": Precision(),
+    "bf16": Precision(compute=jnp.bfloat16),
+    "bfloat16": Precision(compute=jnp.bfloat16),
+    "mixed_bf16": Precision(compute=jnp.bfloat16),
+}
+
+
+def resolve_precision(policy: Union[str, Precision, None]) -> Precision:
+    """Resolve a policy name / Precision / None to a Precision instance.
+    fp32 params are a hard invariant here (the master copy IS the
+    TrainState; a non-fp32 master would silently change every checkpoint
+    and resume path), so only compute/output vary."""
+    if policy is None:
+        return POLICIES["fp32"]
+    if isinstance(policy, Precision):
+        if jnp.dtype(policy.params) != jnp.dtype(jnp.float32):
+            raise ValueError(
+                "Precision.params must be float32 (the TrainState master "
+                f"copy); got {jnp.dtype(policy.params).name}"
+            )
+        return policy
+    try:
+        return POLICIES[str(policy).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown precision policy {policy!r}; expected one of "
+            f"{sorted(set(POLICIES))} or a Precision instance"
+        ) from None
+
+
+@dataclasses.dataclass(frozen=True)
+class LossScaleConfig:
+    """Dynamic loss-scaling knobs (all static: they compile into the step)."""
+
+    init_scale: float = DEFAULT_INIT_SCALE
+    growth_factor: float = GROWTH_FACTOR
+    backoff_factor: float = BACKOFF_FACTOR
+    growth_interval: int = GROWTH_INTERVAL
+    min_scale: float = MIN_SCALE
+    max_scale: float = MAX_SCALE
+
+
+def resolve_loss_scale(
+    loss_scale: Union[str, float, LossScaleConfig, None],
+    precision: Precision,
+) -> Optional[LossScaleConfig]:
+    """Normalize the Trainer's ``loss_scale`` knob.
+
+    ``'dynamic'`` (the default) -> the standard dynamic config; a float ->
+    a STATIC scale (growth/backoff disabled by pinning min == max == init);
+    ``None`` -> no scaling.  Inactive (fp32) precision always resolves to
+    None — the scale arithmetic must not enter the fp32 program."""
+    if not precision.active or loss_scale is None:
+        return None
+    if isinstance(loss_scale, LossScaleConfig):
+        return loss_scale
+    if isinstance(loss_scale, str):
+        if loss_scale.lower() != "dynamic":
+            raise ValueError(
+                f"loss_scale must be 'dynamic', a positive number, a "
+                f"LossScaleConfig, or None; got {loss_scale!r}"
+            )
+        return LossScaleConfig()
+    scale = float(loss_scale)
+    if scale <= 0:
+        raise ValueError(f"loss_scale must be positive, got {scale}")
+    return LossScaleConfig(
+        init_scale=scale, min_scale=scale, max_scale=scale,
+        growth_factor=1.0, backoff_factor=1.0,
+    )
+
+
+def cast_floating(tree, dtype):
+    """Cast every inexact leaf of ``tree`` to ``dtype`` (integer leaves —
+    token ids, masks — pass through untouched)."""
+    def cast(leaf):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.inexact):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree.map(cast, tree)
+
+
+def cast_like(tree, ref):
+    """Cast each leaf of ``tree`` back to the dtype of the matching leaf in
+    ``ref`` — restores state-dtype invariants (batch_stats mutated in bf16
+    must come home fp32 or checkpoints/where-selects break)."""
+    return jax.tree.map(
+        lambda leaf, r: leaf.astype(r.dtype)
+        if hasattr(r, "dtype") and hasattr(leaf, "astype") else leaf,
+        tree, ref,
+    )

@@ -318,7 +318,11 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
                 h = k[len(key_prefix):-1]
                 hosts.setdefault(h, {})[f] = v
 
-    from ml_trainer_tpu.parallel.comm_stats import comm_bytes, comm_calls
+    from ml_trainer_tpu.parallel.comm_stats import (
+        comm_bucket_bytes,
+        comm_bytes,
+        comm_calls,
+    )
 
     event_kinds = ("straggler", "desync", "rollback", "preemption",
                    "nonfinite_steps")
@@ -340,6 +344,7 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
                 "train_samples_per_sec", "train_tokens_per_sec", "train_mfu",
                 "train_steps_total", "train_step_ms_p50", "train_step_ms_p99",
                 "train_comm_bytes_per_step", "train_comm_compute_ratio",
+                "train_overlap_fraction",
             )
             if k in snap
         },
@@ -347,6 +352,12 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
         "hosts": hosts,
         "comm_bytes_by_op": {k: round(v, 1) for k, v in comm_bytes().items()},
         "comm_calls_by_op": comm_calls(),
+        # Per-bucket breakdown of the bucketed collectives (empty unless
+        # the sharded-update path ran): {op: {bucket: bytes}}.
+        "comm_bytes_by_bucket": {
+            op: {b: round(v, 1) for b, v in bs.items()}
+            for op, bs in comm_bucket_bytes().items()
+        },
         "resilience": {
             "skipped_steps": history.get("skipped_steps", []),
             "rollbacks": history.get("rollbacks", 0),

@@ -32,13 +32,32 @@ from __future__ import annotations
 import os
 from typing import Optional, Sequence
 
-# Published peak numbers per chip.  bf16 FLOP/s and HBM bytes/s.
-PEAK_FLOPS = {
-    "v6e": 918e12, "v6": 918e12,
-    "v5p": 459e12,
-    "v5e": 197e12, "v5 lite": 197e12, "v5lite": 197e12,
-    "v4": 275e12,
+# Published peak numbers per chip, keyed by compute dtype.  The bf16 rows
+# are the marketed MXU peaks; fp32 matmuls run through the same MXU at
+# half rate (multi-pass accumulation), so an fp32 training run's
+# attainable ceiling — and therefore an honest MFU denominator — is half
+# the bf16 number.  Using the bf16 peak for an fp32 run understates MFU;
+# using an fp32 peak for a bf16 run overstates it.
+PEAK_FLOPS_BY_DTYPE = {
+    "bf16": {
+        "v6e": 918e12, "v6": 918e12,
+        "v5p": 459e12,
+        "v5e": 197e12, "v5 lite": 197e12, "v5lite": 197e12,
+        "v4": 275e12,
+    },
+    "fp32": {
+        "v6e": 459e12, "v6": 459e12,
+        "v5p": 229.5e12,
+        "v5e": 98.5e12, "v5 lite": 98.5e12, "v5lite": 98.5e12,
+        "v4": 137.5e12,
+    },
 }
+_DTYPE_ALIASES = {
+    "bf16": "bf16", "bfloat16": "bf16",
+    "fp32": "fp32", "float32": "fp32", "f32": "fp32",
+}
+# Back-compat alias (pre-dtype-keyed callers read the bf16 table).
+PEAK_FLOPS = PEAK_FLOPS_BY_DTYPE["bf16"]
 PEAK_HBM_BYTES = {
     "v6e": 1640e9, "v6": 1640e9,
     "v5p": 2765e9,
@@ -65,9 +84,17 @@ def _match_generation() -> Optional[str]:
     return None
 
 
-def chip_peak_flops() -> float:
-    """Peak bf16 FLOP/s of one local chip (v5e fallback)."""
-    return PEAK_FLOPS[_match_generation() or _FALLBACK_GEN]
+def chip_peak_flops(dtype: str = "bf16") -> float:
+    """Peak FLOP/s of one local chip for ``dtype`` compute ('bf16' /
+    'fp32', aliases accepted; v5e fallback generation).  MFU must divide
+    by the peak of the dtype the matmuls actually run in."""
+    key = _DTYPE_ALIASES.get(str(dtype).lower())
+    if key is None:
+        raise ValueError(
+            f"unknown compute dtype {dtype!r}; expected one of "
+            f"{sorted(_DTYPE_ALIASES)}"
+        )
+    return PEAK_FLOPS_BY_DTYPE[key][_match_generation() or _FALLBACK_GEN]
 
 
 def chip_peak_hbm_bytes() -> float:

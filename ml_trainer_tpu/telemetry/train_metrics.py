@@ -81,7 +81,9 @@ class TrainTelemetry:
     def __init__(self, model: Any = None, model_name: str = "",
                  global_batch: int = 0,
                  batch_shape: Optional[Sequence[int]] = None,
-                 registry=None, flight=None, log=None, cluster=None):
+                 registry=None, flight=None, log=None, cluster=None,
+                 compute_dtype: Optional[str] = None,
+                 overlap_fraction: Optional[float] = None):
         self.registry = registry if registry is not None else default_registry()
         self.flight = flight if flight is not None else _flight.get_recorder()
         self.log = log if log is not None else logger
@@ -99,7 +101,15 @@ class TrainTelemetry:
             if model is not None and batch_shape is not None else None
         )
         self._on_tpu = jax.default_backend() == "tpu"
-        self._peak = _flops.chip_peak_flops() if self._on_tpu else None
+        # MFU divides by the peak of the ACTIVE compute dtype: an fp32
+        # run's attainable ceiling is ~half the bf16 MXU peak (flops.py
+        # dtype tables); None keeps the historical bf16 denominator.
+        self.compute_dtype = compute_dtype
+        self._peak = (
+            _flops.chip_peak_flops(compute_dtype or "bf16")
+            if self._on_tpu else None
+        )
+        self.overlap_fraction = overlap_fraction
         self._last_sync_t: Optional[float] = None
         self._last_sync_step = 0
         self._last_skipped = 0
@@ -162,9 +172,22 @@ class TrainTelemetry:
             "analytic collective bytes per training FLOP — the "
             "sharding-bug canary next to MFU",
         )
+        self.g_overlap = r.gauge(
+            "train_overlap_fraction",
+            "analytic fraction of reduce-scatter bytes whose collectives "
+            "can hide under remaining backward compute (bucketed sharded "
+            "update; 0 = fused tail psum, nothing overlaps)",
+        )
+        if overlap_fraction is not None:
+            self.g_overlap.set(float(overlap_fraction))
+        self.g_loss_scale = r.gauge(
+            "train_loss_scale",
+            "current dynamic loss scale (mixed precision; 0 = scaling off)",
+        )
 
     def on_sync(self, step: int, stats: dict, *, epoch: int = 0,
-                skipped_total: int = 0, lr_scale: float = 1.0) -> dict:
+                skipped_total: int = 0, lr_scale: float = 1.0,
+                loss_scale: Optional[float] = None) -> dict:
         """One sync point: fetch ``stats`` (device scalars), update the
         registry, emit the structured event + flight record.  Returns
         the fetched host-side dict (for the caller's own display)."""
@@ -224,6 +247,8 @@ class TrainTelemetry:
         self.g_upd.set(host["update_norm"])
         self.g_ratio.set(host["update_ratio"])
         self.g_lr_scale.set(lr_scale)
+        if loss_scale is not None:
+            self.g_loss_scale.set(float(loss_scale))
         if steps_d > 0:
             self.c_steps.inc(steps_d)
         if skipped_d > 0:
@@ -247,6 +272,10 @@ class TrainTelemetry:
             event["step_ms_p50"] = round(self.step_ms_p50(), 3)
             event["step_ms_p99"] = round(self.step_ms_p99(), 3)
         event["loader_wait_ms"] = round(self.last_loader_wait_ms, 3)
+        if loss_scale is not None:
+            event["loss_scale"] = float(loss_scale)
+        if self.overlap_fraction is not None:
+            event["overlap_fraction"] = round(self.overlap_fraction, 4)
         if comm_b:
             event["comm_bytes_per_step"] = round(comm_b, 1)
         if comm_ratio is not None:

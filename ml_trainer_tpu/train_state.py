@@ -33,3 +33,11 @@ class TrainState(struct.PyTreeNode):
     # existed restore through the compat shim (checkpoint.py).
     skipped_steps: Any = None
     bad_streak: Any = None
+    # Mixed-precision dynamic loss scaling (precision.py): the current
+    # scale (float32 scalar) and the consecutive-finite-step counter that
+    # drives scale growth.  Maintained ON-DEVICE by the compiled step,
+    # like the guard counters; None whenever loss scaling is off (the
+    # fp32 default keeps the exact pre-policy pytree, so fp32 checkpoints
+    # and trajectories are unchanged).
+    loss_scale: Any = None
+    good_steps: Any = None

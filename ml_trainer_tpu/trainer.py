@@ -198,6 +198,10 @@ class Trainer:
         log_every_steps: Optional[int] = None,
         desync_every_steps: Optional[int] = None,
         straggler_factor: float = 2.0,
+        precision: Any = None,
+        loss_scale: Any = "dynamic",
+        dp_update: str = "fused",
+        bucket_mb: float = 4.0,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -343,6 +347,46 @@ class Trainer:
         (``telemetry/cluster.py``; heartbeats allgather at epoch
         boundaries).  Must be > 1.
 
+        Mixed precision / data-parallel hot path (docs/mixed_precision.md):
+
+        ``precision``: ``None``/``'fp32'`` (default — the exact
+        pre-policy program, bit-identical trajectory) or ``'bf16'`` / a
+        ``precision.Precision`` — forward/backward compute in bf16
+        against the fp32 master params in ``TrainState`` (cast once at
+        the top of the loss function; the criterion and metrics read
+        fp32 outputs).  Transformer-family modules additionally get
+        their ``dtype`` knob set so module-internal casts agree.
+
+        ``loss_scale`` (only with an active bf16 policy): ``'dynamic'``
+        (default) scales the loss before backward and unscales the
+        gradients, halving the scale on a non-finite step WITHOUT
+        advancing the rollback streak (overflow is the scale's fault
+        until it has backed off to its floor) and doubling it after
+        ``GROWTH_INTERVAL`` consecutive finite steps; a float pins a
+        static scale; ``None`` disables scaling (bare bf16).  Requires
+        ``nonfinite_guard`` — the skip machinery is the backoff path.
+        The scale and its growth counter live in ``TrainState``
+        (``loss_scale`` / ``good_steps``), maintained on-device.
+
+        ``dp_update``: ``'fused'`` (default) keeps the single implicit
+        gradient psum XLA inserts behind the batch sharding and the
+        replicated weight update.  ``'sharded'`` rewrites the pure-DP
+        hot path per arXiv 2004.13336: gradients leave the backward
+        through size-bounded per-bucket ``reduce_scatter`` collectives
+        (reverse topological order, so each bucket's communication can
+        hide under remaining backward compute), each replica applies the
+        optimizer update only to its 1/N shard of grads/params/moments
+        (ZeRO-1 moments are implied and forced on), and fresh weights
+        return via bucketed ``all_gather`` — update FLOPs and optimizer
+        memory drop by the data-parallel degree with the same math
+        (trajectory-equality test-pinned).  Requires a pure-DP mesh
+        (only a live ``data`` axis), no sharding_rules, no batch_stats
+        models, and ``steps_per_execution=1``.
+
+        ``bucket_mb``: reduce-scatter bucket size bound in MiB for the
+        sharded path (default 4) — smaller buckets start communicating
+        earlier but pay more per-collective latency.
+
         ``handle_preemption`` (default True): ``fit()`` installs
         SIGTERM/SIGINT handlers (restored on exit) that finish the
         in-flight step, write an emergency mid-epoch checkpoint plus a
@@ -410,8 +454,44 @@ class Trainer:
         # device sync we only pay every `log_every` steps.
         self.log_every = 50
 
+        from ml_trainer_tpu.precision import (
+            resolve_loss_scale,
+            resolve_precision,
+        )
+
+        self.precision = resolve_precision(precision)
+        self._compute_dtype = (
+            self.precision.compute if self.precision.active else None
+        )
+        self._loss_scale_cfg = resolve_loss_scale(loss_scale, self.precision)
+        if self._loss_scale_cfg is not None and not nonfinite_guard:
+            raise ValueError(
+                "loss scaling rides the non-finite guard (overflow steps "
+                "are skipped by the same where-selects); pass "
+                "loss_scale=None to run bare bf16 with nonfinite_guard "
+                "disabled"
+            )
+        if dp_update not in ("fused", "sharded"):
+            raise ValueError(
+                f"dp_update must be 'fused' | 'sharded', got {dp_update!r}"
+            )
+        if bucket_mb <= 0:
+            raise ValueError(f"bucket_mb must be positive, got {bucket_mb}")
+        self.dp_update = dp_update
+        self.bucket_mb = float(bucket_mb)
         if isinstance(model, str):
-            model = get_model(model)
+            model = get_model(model, precision=self.precision)
+        elif (
+            self._compute_dtype is not None
+            and hasattr(model, "dtype")
+            and hasattr(model, "clone")
+            and jnp.dtype(model.dtype) != jnp.dtype(self._compute_dtype)
+        ):
+            # Thread the compute dtype onto modules that carry a dtype
+            # knob (the transformer zoo) so module-internal casts agree
+            # with the trainer-level policy; params stay fp32
+            # (flax's separate param_dtype).
+            model = model.clone(dtype=self._compute_dtype)
         self.model = model
         self._takes_train = _module_takes_train(model)
         self._takes_targets = _module_takes_targets(model)
@@ -550,6 +630,41 @@ class Trainer:
         ) if any(a in self.mesh.axis_names for a in ("data", "fsdp")) else 1
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
+        if self.dp_update == "sharded":
+            # Pure-DP only: the sharded update re-expresses the gradient
+            # psum as explicit reduce-scatter/all-gather over the data
+            # axis; model-parallel axes would need their own collectives
+            # composed in (tracked as future work in docs).
+            model_axes = [
+                a for a in self.mesh.axis_names
+                if a != "data" and self.mesh.shape[a] > 1
+            ]
+            if self._sharding_rules is not None or model_axes:
+                raise ValueError(
+                    "dp_update='sharded' requires a pure data-parallel "
+                    f"mesh with no sharding_rules; got mesh axes "
+                    f"{dict(self.mesh.shape)}"
+                )
+            if self.steps_per_execution > 1:
+                raise ValueError(
+                    "dp_update='sharded' requires steps_per_execution=1"
+                )
+            if "data" not in self.mesh.axis_names or (
+                self.mesh.shape["data"] < 2
+            ):
+                logger.warning(
+                    "dp_update='sharded' on a single-replica mesh has "
+                    "nothing to shard; falling back to the fused step."
+                )
+                self.dp_update = "fused"
+            elif not self._shard_opt_state:
+                # The sharded update owns 1/N of the moments by
+                # construction — ZeRO-1 placement is implied.
+                logger.info(
+                    "dp_update='sharded' implies shard_opt_state=True "
+                    "(ZeRO-1 moment placement)."
+                )
+                self._shard_opt_state = True
 
         logger.info(f"Training on device: {jax.default_backend()}.")
 
@@ -731,6 +846,13 @@ class Trainer:
         params = variables["params"]
         batch_stats = variables.get("batch_stats", {})
         self._has_batch_stats = bool(batch_stats)
+        if self.dp_update == "sharded" and self._has_batch_stats:
+            raise ValueError(
+                "dp_update='sharded' does not support batch_stats models "
+                "(per-replica BatchNorm statistics inside the shard_map "
+                "body would diverge from the fused global-batch stats); "
+                "use the fused step for BatchNorm models"
+            )
         # Detect sown auxiliary losses (MoEMLP's load-balance term) with a
         # shape-only trace of the TRAIN-mode forward — init() runs at
         # train=False, which would miss losses gated on training (router
@@ -766,10 +888,15 @@ class Trainer:
         # Always chain (both clip and identity carry EmptyState), so the
         # opt_state pytree structure — and therefore checkpoints — do not
         # depend on whether clipping is on: the flag can toggle across a
-        # resume.
+        # resume.  The sharded-update path keeps the identity slot and
+        # clips manually instead: inside its step the optimizer sees 1/N
+        # shards, so optax's clip would compute a per-replica norm — the
+        # step psums the true global norm itself (same math, same
+        # opt_state structure).
         self.tx = optax.chain(
             optax.clip_by_global_norm(self.grad_clip_norm)
-            if self.grad_clip_norm is not None
+            if (self.grad_clip_norm is not None
+                and self.dp_update != "sharded")
             else optax.identity(),
             self.tx,
         )
@@ -869,19 +996,23 @@ class Trainer:
         # not multi-host-safe.
         from ml_trainer_tpu.parallel import place_tree
 
+        host_scalars = {
+            "step": jnp.zeros((), jnp.int32),
+            "rng": state_rng,
+            "skipped": jnp.zeros((), jnp.int32),
+            "streak": jnp.zeros((), jnp.int32),
+        }
+        if self._loss_scale_cfg is not None:
+            # Dynamic loss scaling: the scale and its growth counter are
+            # on-device state, updated by the same compiled step that
+            # uses them (precision.py semantics).
+            host_scalars["loss_scale"] = jnp.asarray(
+                self._loss_scale_cfg.init_scale, jnp.float32
+            )
+            host_scalars["good"] = jnp.zeros((), jnp.int32)
         scalars = place_tree(
-            {
-                "step": jnp.zeros((), jnp.int32),
-                "rng": state_rng,
-                "skipped": jnp.zeros((), jnp.int32),
-                "streak": jnp.zeros((), jnp.int32),
-            },
-            {
-                "step": self._replicated,
-                "rng": self._replicated,
-                "skipped": self._replicated,
-                "streak": self._replicated,
-            },
+            host_scalars,
+            {k: self._replicated for k in host_scalars},
         )
         self.state = TrainState(
             step=scalars["step"],
@@ -894,6 +1025,8 @@ class Trainer:
             # maintain them without a host sync (fetched once per epoch).
             skipped_steps=scalars["skipped"],
             bad_streak=scalars["streak"],
+            loss_scale=scalars.get("loss_scale"),
+            good_steps=scalars.get("good"),
         )
         self._state_shardings = jax.tree.map(lambda x: x.sharding, self.state)
         if self._sharded_ckpt is None:
@@ -912,6 +1045,20 @@ class Trainer:
                     "Partitioned multi-host state: using per-host sharded "
                     "checkpoints (sharded_checkpoint=True)."
                 )
+        self._bucket_plan = None
+        if self.dp_update == "sharded":
+            from ml_trainer_tpu.parallel import plan_grad_buckets
+
+            self._bucket_plan = plan_grad_buckets(
+                params, int(self.mesh.shape["data"]),
+                bucket_bytes=int(self.bucket_mb * 2 ** 20),
+            )
+            logger.info(
+                f"Sharded DP update: {len(self._bucket_plan.buckets)} "
+                f"reduce-scatter buckets over data={self.mesh.shape['data']} "
+                f"(bucket_mb={self.bucket_mb}, analytic overlap fraction "
+                f"{self._bucket_plan.overlap_fraction:.2f})."
+            )
         if self.telemetry:
             from ml_trainer_tpu.telemetry.cluster import ClusterTelemetry
             from ml_trainer_tpu.telemetry.train_metrics import TrainTelemetry
@@ -930,8 +1077,16 @@ class Trainer:
                 batch_shape=(self.global_batch,) + tuple(sample_x.shape[1:]),
                 flight=self._flight,
                 cluster=self._cluster,
+                compute_dtype=self.precision.label(),
+                overlap_fraction=(
+                    self._bucket_plan.overlap_fraction
+                    if self._bucket_plan is not None else None
+                ),
             )
-        train_step = self._make_train_step()
+        train_step = (
+            self._make_sharded_train_step()
+            if self.dp_update == "sharded" else self._make_train_step()
+        )
         # Pin the output state to the SAME shardings it was born with: the
         # state's placement is a class invariant (resume/device_put, the
         # export path, and the v3 checkpoint writer all key off
@@ -982,20 +1137,36 @@ class Trainer:
             multi=self.steps_per_execution > 1,
         )
 
-    def _make_train_step(self):
-        criterion, metric_fn, tx = self.criterion, self.metric_fn, self.tx
+    def _make_grads_for(self):
+        """The shared forward/backward closure of both train-step flavors:
+        ``grads_for(params, batch_stats, x, y, dropout_rng, scale=None)``
+        returns ``(grads, new_bs, loss, metric_val)`` where ``grads``
+        differentiate ``scale * loss`` (the caller unscales once, after
+        any accumulation) and ``loss``/``metric_val`` are unscaled.  With
+        an active bf16 policy, master params and float inputs cast to the
+        compute dtype at the top (gradients come home fp32 through the
+        cast's vjp) and outputs cast back to fp32 before the criterion;
+        at fp32 the traced program is exactly the pre-policy one."""
+        criterion, metric_fn = self.criterion, self.metric_fn
         has_bs, model_apply = self._has_batch_stats, self._apply
         takes_targets = self._takes_targets
         has_aux = getattr(self, "_has_aux_losses", False)
         aux_weight = self.moe_aux_weight
-        accum = self.grad_accum_steps
-        ema_decay = self.ema_decay
-        guard = self.nonfinite_guard
-        telemetry = self.telemetry
+        compute_dtype = self._compute_dtype
+        if compute_dtype is not None:
+            from ml_trainer_tpu.precision import cast_floating, cast_like
 
-        def grads_for(params, batch_stats, x, y, dropout_rng):
+        def grads_for(params, batch_stats, x, y, dropout_rng, scale=None):
             def loss_fn(params):
-                variables = {"params": params}
+                if compute_dtype is not None:
+                    p_apply = cast_floating(params, compute_dtype)
+                    x_apply = (
+                        x.astype(compute_dtype)
+                        if jnp.issubdtype(x.dtype, jnp.inexact) else x
+                    )
+                else:
+                    p_apply, x_apply = params, x
+                variables = {"params": p_apply}
                 if has_bs:
                     variables["batch_stats"] = batch_stats
                 mutable_cols = (["batch_stats"] if has_bs else []) + (
@@ -1006,18 +1177,26 @@ class Trainer:
                 fwd_targets = y if takes_targets else None
                 if mutable_cols:
                     out, mutated = model_apply(
-                        variables, x, train=True,
+                        variables, x_apply, train=True,
                         rngs={"dropout": dropout_rng}, mutable=mutable_cols,
                         targets=fwd_targets,
                     )
                     new_bs = mutated.get("batch_stats", batch_stats)
+                    if compute_dtype is not None and has_bs:
+                        # Stats mutated under bf16 come home at the state
+                        # dtype (checkpoints and where-selects depend on
+                        # dtype-stable state leaves).
+                        new_bs = cast_like(new_bs, batch_stats)
                 else:
                     out = model_apply(
-                        variables, x, train=True,
+                        variables, x_apply, train=True,
                         rngs={"dropout": dropout_rng}, targets=fwd_targets,
                     )
                     mutated = {}
                     new_bs = batch_stats
+                if compute_dtype is not None and hasattr(out, "astype"):
+                    # Precision.output: criterion/metrics read fp32.
+                    out = out.astype(jnp.float32)
                 loss = out if takes_targets else criterion(out, y)
                 if has_aux:
                     # Sown auxiliary losses (e.g. MoE load-balance,
@@ -1025,9 +1204,10 @@ class Trainer:
                     aux_terms = jax.tree.leaves(mutated.get("losses", {}))
                     if aux_terms:
                         loss = loss + aux_weight * sum(aux_terms)
-                return loss, (out, new_bs)
+                scaled = loss if scale is None else loss * scale
+                return scaled, (loss, out, new_bs)
 
-            (loss, (out, new_bs)), grads = jax.value_and_grad(
+            (_, (loss, out, new_bs)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(params)
             metric_val = (
@@ -1035,8 +1215,52 @@ class Trainer:
             )
             return grads, new_bs, loss, metric_val
 
+        return grads_for
+
+    def _scale_streak_updates(self, state, ok, cfg, one, zero):
+        """Shared guard bookkeeping for loss scaling: the bad-streak rule
+        (an overflow is the scale's fault while it can still back off —
+        it must NOT advance the rollback streak) and the dynamic
+        scale/growth-counter arithmetic.  Returns
+        ``(new_streak, replace_kwargs)``."""
+        if cfg is None:
+            return jnp.where(ok, zero, state.bad_streak + one), {}
+        attributed = state.loss_scale > cfg.min_scale
+        new_streak = jnp.where(
+            ok, zero,
+            jnp.where(attributed, state.bad_streak, state.bad_streak + one),
+        )
+        grown = state.good_steps + one >= cfg.growth_interval
+        new_scale = jnp.where(
+            ok,
+            jnp.where(
+                grown,
+                jnp.minimum(
+                    state.loss_scale * cfg.growth_factor, cfg.max_scale
+                ),
+                state.loss_scale,
+            ),
+            jnp.maximum(state.loss_scale * cfg.backoff_factor, cfg.min_scale),
+        )
+        new_good = jnp.where(
+            ok & ~grown, state.good_steps + one, jnp.zeros_like(
+                state.good_steps
+            )
+        )
+        return new_streak, {"loss_scale": new_scale, "good_steps": new_good}
+
+    def _make_train_step(self):
+        tx = self.tx
+        accum = self.grad_accum_steps
+        ema_decay = self.ema_decay
+        guard = self.nonfinite_guard
+        telemetry = self.telemetry
+        cfg = self._loss_scale_cfg
+        grads_for = self._make_grads_for()
+
         def train_step(state: TrainState, x, y, lr_scale):
             rng, dropout_rng = jax.random.split(state.rng)
+            scale = state.loss_scale if cfg is not None else None
             # Data-parallel gradient averaging happens implicitly in
             # grads_for: the batch is sharded over the mesh's data axis while
             # params are replicated, so XLA inserts the psum the reference
@@ -1044,8 +1268,10 @@ class Trainer:
             # (ref: src/trainer.py:98, 152-158).
             if accum == 1:
                 grads, new_bs, loss, metric_val = grads_for(
-                    state.params, state.batch_stats, x, y, dropout_rng
+                    state.params, state.batch_stats, x, y, dropout_rng, scale
                 )
+                if scale is not None:
+                    grads = jax.tree.map(lambda g: g / scale, grads)
             else:
                 # lax.scan over microbatches: gradients sum on-device, one
                 # optimizer update per global batch (GPT-2 grad-accum
@@ -1057,7 +1283,7 @@ class Trainer:
                 def body(carry, xy):
                     bs, g_sum, l_sum, m_sum, drng = carry
                     drng, sub = jax.random.split(drng)
-                    g, bs, l, m = grads_for(state.params, bs, *xy, sub)
+                    g, bs, l, m = grads_for(state.params, bs, *xy, sub, scale)
                     g_sum = jax.tree.map(jnp.add, g_sum, g)
                     return (bs, g_sum, l_sum + l, m_sum + m, drng), None
 
@@ -1068,7 +1294,12 @@ class Trainer:
                      dropout_rng),
                     (xm, ym),
                 )
-                grads = jax.tree.map(lambda g: g / accum, g_sum)
+                if scale is None:
+                    grads = jax.tree.map(lambda g: g / accum, g_sum)
+                else:
+                    # One unscale folds the microbatch mean and the loss
+                    # scale (the scale was constant across the scan).
+                    grads = jax.tree.map(lambda g: g / (accum * scale), g_sum)
                 loss = l_sum / accum
                 metric_val = m_sum / accum
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -1082,6 +1313,7 @@ class Trainer:
                 if ema_decay is not None else state.ema_params
             )
             new_skipped, new_streak = state.skipped_steps, state.bad_streak
+            replace_kwargs = {}
             raw_loss = loss  # pre-guard: telemetry must SEE the NaN
             if guard:
                 # On-device all-finite guard: a non-finite loss or any
@@ -1113,7 +1345,12 @@ class Trainer:
                 one = jnp.ones((), jnp.int32)
                 zero = jnp.zeros((), jnp.int32)
                 new_skipped = state.skipped_steps + jnp.where(ok, zero, one)
-                new_streak = jnp.where(ok, zero, state.bad_streak + one)
+                # Loss scaling folds into the guard here: an overflow
+                # halves the scale WITHOUT advancing the rollback streak
+                # (fp32 / no-scaling keeps the exact pre-policy streak).
+                new_streak, replace_kwargs = self._scale_streak_updates(
+                    state, ok, cfg, one, zero
+                )
                 # A skipped step contributes zero to the epoch sums so
                 # one NaN cannot poison the whole epoch's history.
                 loss = jnp.where(ok, loss, jnp.zeros_like(loss))
@@ -1129,6 +1366,7 @@ class Trainer:
                 ema_params=new_ema,
                 skipped_steps=new_skipped,
                 bad_streak=new_streak,
+                **replace_kwargs,
             )
             if telemetry:
                 # On-device step stats (telemetry/train_metrics.py):
@@ -1145,6 +1383,258 @@ class Trainer:
 
         return train_step
 
+    def _make_sharded_train_step(self):
+        """The bucketed reduce-scatter + cross-replica sharded-update step
+        (dp_update='sharded'; arXiv 2004.13336 composed with TorchTitan's
+        bucketed comm/compute overlap).
+
+        One ``shard_map`` over the pure-DP data axis replaces the
+        compiler-inserted tail psum with explicit structure:
+
+        1. each replica runs forward/backward on its batch shard (local
+           gradients, never globally reduced in full);
+        2. gradients leave through per-bucket ``reduce_scatter`` calls in
+           reverse topological order — each bucket's collective depends
+           only on its own leaves' gradients, so the XLA latency-hiding
+           scheduler can run it while earlier layers' gradients are
+           still computing (a single fused psum serializes after the
+           whole backward);
+        3. the optimizer update runs on this replica's 1/N shard of
+           grads/params/ZeRO-1 moments (update FLOPs and moment memory
+           ÷ N); grad clipping psums the true global norm first;
+        4. fresh weights return via per-bucket ``all_gather``.
+
+        Math matches the fused step (trajectory-equality test-pinned):
+        reduce-scatter of local-mean grads / N == the global-mean psum,
+        and every optimizer in the zoo is elementwise per leaf."""
+        from jax import lax
+
+        from ml_trainer_tpu.parallel import (
+            bucketed_all_gather,
+            bucketed_reduce_scatter,
+            collectives as col,
+        )
+        from ml_trainer_tpu.parallel.compat import shard_map
+        from ml_trainer_tpu.telemetry.train_metrics import _global_norm
+
+        mesh = self.mesh
+        n = int(mesh.shape["data"])
+        plan = self._bucket_plan
+        tx = self.tx
+        accum = self.grad_accum_steps
+        ema_decay = self.ema_decay
+        guard = self.nonfinite_guard
+        telemetry = self.telemetry
+        cfg = self._loss_scale_cfg
+        clip = self.grad_clip_norm
+        grads_for = self._make_grads_for()
+        param_leaves = jax.tree.leaves(self.state.params)
+        full_shapes = [leaf.shape for leaf in param_leaves]
+
+        def split_sq(leaves):
+            """(local-shard sq-sum, replicated sq-sum) of a mixed tree —
+            the psum of the first plus the second is the global sq-norm."""
+            loc = jnp.zeros((), jnp.float32)
+            rep = jnp.zeros((), jnp.float32)
+            for i, leaf in enumerate(leaves):
+                s = jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+                loc, rep = (loc + s, rep) if plan.sharded[i] else (loc, rep + s)
+            return loc, rep
+
+        def body(state: TrainState, x, y, lr_scale):
+            rng, dropout_rng = jax.random.split(state.rng)
+            scale = state.loss_scale if cfg is not None else None
+            if accum == 1:
+                grads, _, loss, metric_val = grads_for(
+                    state.params, state.batch_stats, x, y, dropout_rng, scale
+                )
+            else:
+                micro = x.shape[0] // accum
+                xm = x.reshape((accum, micro) + x.shape[1:])
+                ym = y.reshape((accum, micro) + y.shape[1:])
+
+                def accum_body(carry, xy):
+                    bs, g_sum, l_sum, m_sum, drng = carry
+                    drng, sub = jax.random.split(drng)
+                    g, bs, l, m = grads_for(state.params, bs, *xy, sub, scale)
+                    g_sum = jax.tree.map(jnp.add, g_sum, g)
+                    return (bs, g_sum, l_sum + l, m_sum + m, drng), None
+
+                zeros = jax.tree.map(jnp.zeros_like, state.params)
+                (_, grads, l_sum, m_sum, _), _ = jax.lax.scan(
+                    accum_body,
+                    (state.batch_stats, zeros, jnp.zeros(()), jnp.zeros(()),
+                     dropout_rng),
+                    (xm, ym),
+                )
+                loss = l_sum / accum
+                metric_val = m_sum / accum
+            # Epoch accounting reads global means (what the fused step's
+            # sharded-batch criterion computes implicitly).
+            loss = col.pmean(loss, "data")
+            metric_val = col.pmean(metric_val, "data")
+
+            g_leaves, g_def = jax.tree.flatten(grads)
+            # (2) bucketed reduce-scatter: one collective per bucket, in
+            # reverse backward-production order; each replica keeps its
+            # 1/N dim-0 shard, summed across replicas.
+            g_leaves = bucketed_reduce_scatter(g_leaves, plan, "data")
+            rep_idx = [
+                i for i in range(len(g_leaves)) if not plan.sharded[i]
+            ]
+            if rep_idx:
+                # Indivisible leaves (rare: odd-dim heads, scalars) keep a
+                # replicated update — ONE fused psum over their concat.
+                flat = col.psum(
+                    jnp.concatenate(
+                        [g_leaves[i].reshape(-1) for i in rep_idx]
+                    ),
+                    "data",
+                )
+                off = 0
+                for i in rep_idx:
+                    size = int(np.prod(g_leaves[i].shape, initial=1))
+                    g_leaves[i] = flat[off:off + size].reshape(
+                        g_leaves[i].shape
+                    )
+                    off += size
+            # Scatter/psum SUMMED local-mean grads: /n folds the replica
+            # mean, /accum the microbatch mean, /scale the loss scale.
+            denom = float(n * accum)
+            if scale is None:
+                g_leaves = [g / denom for g in g_leaves]
+            else:
+                g_leaves = [g / (denom * scale) for g in g_leaves]
+
+            # (3) this replica's parameter shards (dim-0 block at its
+            # axis index), moments arrive pre-sharded via in_specs.
+            idx = col.axis_index("data")
+            p_mixed = []
+            for i, p in enumerate(jax.tree.leaves(state.params)):
+                if plan.sharded[i]:
+                    blocks = p.reshape((n, p.shape[0] // n) + p.shape[1:])
+                    p_mixed.append(
+                        lax.dynamic_index_in_dim(
+                            blocks, idx, axis=0, keepdims=False
+                        )
+                    )
+                else:
+                    p_mixed.append(p)
+            params_mixed = jax.tree.unflatten(g_def, p_mixed)
+            grads_mixed = jax.tree.unflatten(g_def, g_leaves)
+
+            g_sq = None
+            if clip is not None or telemetry:
+                loc, rep = split_sq(g_leaves)
+                g_sq = col.psum(loc, "data") + rep
+            if clip is not None:
+                # optax.clip_by_global_norm math over the TRUE global
+                # norm (the chained optax clip would see one shard).
+                gnorm = jnp.sqrt(g_sq)
+                factor = clip / jnp.maximum(gnorm, clip)
+                grads_mixed = jax.tree.map(lambda g: g * factor, grads_mixed)
+
+            updates, new_opt = tx.update(
+                grads_mixed, state.opt_state, params_mixed
+            )
+            updates = jax.tree.map(lambda u: u * lr_scale, updates)
+            new_params_mixed = optax.apply_updates(params_mixed, updates)
+
+            new_skipped, new_streak = state.skipped_steps, state.bad_streak
+            replace_kwargs = {}
+            raw_loss = loss
+            if guard:
+                ok = jnp.isfinite(loss)
+                for g in jax.tree.leaves(grads_mixed):
+                    ok = ok & jnp.all(jnp.isfinite(g))
+                # Global consensus: a non-finite value lives only in the
+                # shard of the replica that owns it — every replica must
+                # take the same skip decision.
+                ok = col.psum(jnp.where(ok, 1.0, 0.0), "data") > (n - 0.5)
+
+                def sel(new, old):
+                    return jax.tree.map(
+                        lambda a, b: jnp.where(ok, a, b), new, old
+                    )
+
+                new_params_mixed = sel(new_params_mixed, params_mixed)
+                new_opt = sel(new_opt, state.opt_state)
+                one = jnp.ones((), jnp.int32)
+                zero = jnp.zeros((), jnp.int32)
+                new_skipped = state.skipped_steps + jnp.where(ok, zero, one)
+                new_streak, replace_kwargs = self._scale_streak_updates(
+                    state, ok, cfg, one, zero
+                )
+                loss = jnp.where(ok, loss, jnp.zeros_like(loss))
+                metric_val = jnp.where(
+                    ok, metric_val, jnp.zeros_like(metric_val)
+                )
+            # (4) fresh weights: bucketed all-gather of the (guarded)
+            # shards back to the full replicated tree.
+            full_leaves = bucketed_all_gather(
+                jax.tree.leaves(new_params_mixed), plan, full_shapes, "data"
+            )
+            new_params = jax.tree.unflatten(g_def, full_leaves)
+            new_ema = (
+                jax.tree.map(
+                    lambda e, p: ema_decay * e + (1.0 - ema_decay) * p,
+                    state.ema_params, new_params,
+                )
+                if ema_decay is not None else state.ema_params
+            )
+            if guard and ema_decay is not None:
+                new_ema = jax.tree.map(
+                    lambda a, b: jnp.where(ok, a, b),
+                    new_ema, state.ema_params,
+                )
+            new_state = state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt,
+                batch_stats=state.batch_stats,
+                rng=rng,
+                ema_params=new_ema,
+                skipped_steps=new_skipped,
+                bad_streak=new_streak,
+                **replace_kwargs,
+            )
+            if telemetry:
+                u_loc, u_rep = split_sq(jax.tree.leaves(updates))
+                un = jnp.sqrt(col.psum(u_loc, "data") + u_rep)
+                pn = _global_norm(new_params)
+                stats = {
+                    "loss_raw": jnp.asarray(raw_loss, jnp.float32),
+                    "grad_norm": jnp.sqrt(g_sq),
+                    "param_norm": pn,
+                    "update_norm": un,
+                    "update_ratio": un / (pn + 1e-12),
+                }
+                return new_state, loss, metric_val, stats
+            return new_state, loss, metric_val
+
+        state_specs = jax.tree.map(lambda sh: sh.spec, self._state_shardings)
+        batch_spec = self._batch_sharding.spec
+        scalar_spec = P()
+        out_specs = (
+            (state_specs, scalar_spec, scalar_spec, scalar_spec)
+            if telemetry else (state_specs, scalar_spec, scalar_spec)
+        )
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(state_specs, batch_spec, batch_spec, scalar_spec),
+            out_specs=out_specs,
+            # Outputs declared P() are replicated by construction (the
+            # all-gathered weights and pmean'd scalars are identical on
+            # every replica); the checker cannot prove it through the
+            # where-selects, so it is off.
+            check_rep=False,
+        )
+
+        def sharded_train_step(state, x, y, lr_scale):
+            return mapped(state, x, y, lr_scale)
+
+        return sharded_train_step
+
     def _make_eval_step(self, module, takes_train, has_bs, multi=False):
         """Compiled eval step for ``module``; with ``multi`` also returns
         the K-batches-per-dispatch variant (scan), else None.  Pure — no
@@ -1152,6 +1642,9 @@ class Trainer:
         through this too)."""
         criterion, metric_fn = self.criterion, self.metric_fn
         takes_targets = _module_takes_targets(module)
+        compute_dtype = self._compute_dtype
+        if compute_dtype is not None:
+            from ml_trainer_tpu.precision import cast_floating
         if takes_targets and metric_fn is not None:
             # The constructor guard only covers the trainer's own model;
             # test() evaluates foreign modules through here too, and a
@@ -1163,13 +1656,25 @@ class Trainer:
 
         def eval_step(variables, x, y):
             kwargs = {"train": False} if takes_train else {}
+            if compute_dtype is not None:
+                # Same policy as training: compute in bf16 against the
+                # fp32 masters, score losses/metrics in fp32.
+                variables = dict(
+                    variables,
+                    params=cast_floating(variables["params"], compute_dtype),
+                )
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.inexact):
+                    x = jnp.asarray(x).astype(compute_dtype)
             if takes_targets:
                 # Self-loss model: the forward returns the scalar loss
                 # (metric is None for these — validated at construction).
-                return module.apply(variables, x, targets=y, **kwargs), (
-                    jnp.zeros(())
-                )
+                loss = module.apply(variables, x, targets=y, **kwargs)
+                if compute_dtype is not None:
+                    loss = loss.astype(jnp.float32)
+                return loss, jnp.zeros(())
             out = module.apply(variables, x, **kwargs)
+            if compute_dtype is not None:
+                out = out.astype(jnp.float32)
             loss = criterion(out, y)
             metric_val = (
                 metric_fn(out, y) if metric_fn is not None else jnp.zeros(())
@@ -1303,6 +1808,7 @@ class Trainer:
                                 gstep, stats, epoch=epoch,
                                 skipped_total=self._skipped_now(),
                                 lr_scale=self._lr_scale,
+                                loss_scale=self._loss_scale_now(),
                             )
                         if self._maybe_rollback(gstep):
                             lr_scale = jnp.asarray(
@@ -1394,6 +1900,7 @@ class Trainer:
                             (epoch - 1) * n + done, stats, epoch=epoch,
                             skipped_total=self._skipped_now(),
                             lr_scale=self._lr_scale,
+                            loss_scale=self._loss_scale_now(),
                         )
 
             for xs, ys in stacked:
@@ -1822,6 +2329,13 @@ class Trainer:
             return 0
         return int(jax.device_get(self.state.skipped_steps))
 
+    def _loss_scale_now(self) -> Optional[float]:
+        """Current dynamic loss scale (one scalar fetch; None when
+        scaling is off — the gauge/event field then stays absent)."""
+        if self.state is None or self.state.loss_scale is None:
+            return None
+        return float(jax.device_get(self.state.loss_scale))
+
     def _flight_dir(self) -> str:
         """Flight dumps land next to the checkpoints unless the env var
         redirects them (telemetry/flight.py resolution order)."""
@@ -1912,6 +2426,7 @@ class Trainer:
         self.state = self.state.replace(
             bad_streak=zero, skipped_steps=skipped_now
         )
+        self._reseed_loss_scale()
         logger.warning(
             f"Rollback: {streak} consecutive non-finite steps; restored "
             f"{latest} and backed LR off to scale {self._lr_scale:.4g}."
@@ -1954,6 +2469,26 @@ class Trainer:
                 os.remove(marker)
             except OSError:
                 pass
+
+    def _reseed_loss_scale(self) -> None:
+        """After any restore: a checkpoint written before loss scaling
+        existed (or by an fp32 run) lands a zero ``loss_scale`` through
+        the compat shim — re-seed it to this run's configured initial
+        scale (one scalar fetch; no-op when scaling is off)."""
+        if self._loss_scale_cfg is None or self.state.loss_scale is None:
+            return
+        if float(jax.device_get(self.state.loss_scale)) <= 0.0:
+            self.state = self.state.replace(
+                loss_scale=jax.device_put(
+                    jnp.asarray(
+                        self._loss_scale_cfg.init_scale, jnp.float32
+                    ),
+                    self._replicated,
+                ),
+                good_steps=jax.device_put(
+                    jnp.zeros((), jnp.int32), self._replicated
+                ),
+            )
 
     def _sync_skipped_base(self) -> None:
         """Re-anchor the per-epoch skipped-step delta after a restore (one
@@ -2032,6 +2567,7 @@ class Trainer:
             self.state = state
             self._apply_resume_scalars(saved)
             self._sync_skipped_base()
+            self._reseed_loss_scale()
             mid = saved.get("mid_epoch")
             if mid is not None:
                 self._require_mid_resume_support()
@@ -2100,6 +2636,7 @@ class Trainer:
         self._best_val = float(scalars[5])
         self._bad_epochs = int(scalars[6])
         self._sync_skipped_base()
+        self._reseed_loss_scale()
         if scalars[7]:
             # Mid-epoch checkpoint: re-enter the manifest's epoch at the
             # saved batch cursor instead of starting the next epoch.

@@ -8,12 +8,17 @@
 # (registry gauges, Prometheus exposition, spans, flight dumps, cluster
 # aggregation, run report, comm-bytes accounting), a paged-serving
 # smoke leg (scripts/paged_serving_smoke.py) covering the PR6 paged KV
-# + prefix cache + preempt-requeue stack end to end, and a bench
-# regression gate (scripts/bench_gate.py) that fails on >10% samples/s
-# regression vs the committed BENCH trajectory / this machine's
-# calibrated baseline — plus the paged-serving replay gate (byte
-# identity, zero-recompile, paged-vs-contiguous ratio, tokens/s
-# ratchet vs docs/serving_replay_cpu.json).
+# + prefix cache + preempt-requeue stack end to end, a mixed-precision /
+# sharded-update smoke leg (scripts/mixed_smoke.py: 2-virtual-device
+# bucketed-overlap + bf16 dryrun, zero recompiles, finite loss,
+# overflow-backoff semantics), and a bench regression gate
+# (scripts/bench_gate.py) that fails on >10% samples/s regression vs
+# the committed BENCH trajectory / this machine's calibrated baseline —
+# plus the paged-serving replay gate (byte identity, zero-recompile,
+# paged-vs-contiguous ratio, tokens/s ratchet vs
+# docs/serving_replay_cpu.json) and the mixed gate (finite/zero-recompile
+# invariants, sharded>=fused floor, ratchet vs
+# docs/mixed_precision_cpu.json).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -38,13 +43,18 @@ echo "# paged serving smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/paged_serving_smoke.py
 paged_rc=$?
 [ $paged_rc -ne 0 ] && echo "# paged serving smoke FAILED (rc=$paged_rc)"
+echo "# mixed-precision / sharded-update smoke leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/mixed_smoke.py
+mixed_rc=$?
+[ $mixed_rc -ne 0 ] && echo "# mixed smoke FAILED (rc=$mixed_rc)"
 echo "# bench regression gate"
-timeout -k 10 540 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 780 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 [ $rc -eq 0 ] && rc=$smoke_rc
 [ $rc -eq 0 ] && rc=$telemetry_rc
 [ $rc -eq 0 ] && rc=$paged_rc
+[ $rc -eq 0 ] && rc=$mixed_rc
 [ $rc -eq 0 ] && rc=$gate_rc
 exit $rc
