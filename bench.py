@@ -1248,6 +1248,208 @@ def _write_mixed_artifact(result, out_path) -> None:
     print(f"# mixed artifact -> {out_path}", flush=True)
 
 
+PIPELINE_MATRIX = (
+    # (n_stage_devices, n_virtual, n_micro, schedule, remat)
+    (2, 1, 4, "gpipe", False),
+    (2, 1, 4, "1f1b", False),
+    (4, 1, 8, "gpipe", False),       # the S=4/M=8 acceptance pair
+    (4, 1, 8, "1f1b", False),
+    (4, 1, 8, "zb", False),
+    (4, 2, 8, "interleaved", False),  # 8 virtual stages on 4 devices
+    (4, 1, 8, "gpipe", True),        # memory-bounded pair: gpipe remat
+    (4, 1, 8, "1f1b", True),         # vs 1F1B's O(S) combined backward
+)
+
+
+def bench_pipeline(n_devices=4, width=64, mb_rows=8, iters=20, warmup=5,
+                   reps=2, out_path=None):
+    """Pipeline-schedule matrix on a virtual ``stage`` mesh (the
+    ``dryrun_multichip`` style: CPU with forced host devices, same
+    compiled collectives as the chip): one jitted ``value_and_grad`` of
+    a pipelined stage stack per row — the schedule engine itself, no
+    trainer machinery in the timed region.
+
+    Each row records the fenced steady-state step time, the analytic
+    tick-table facts (bubble fraction, executed-compute waste, stash
+    sizing from ``pipeline_schedule_info``), the per-hop comm bytes
+    (``comm_bytes_by_hop{schedule=,hop=}``), a trajectory-equality check
+    against the serial fold (value AND grad), and the compiled-program
+    pin.  Headline: the 1F1B-vs-GPipe step-time ratio at S=4/M=8 —
+    GPipe's scan executes garbage compute in its bubble slots on every
+    device while the tick-table engine skips idle slots, so 1F1B should
+    hold or beat it.  Needs ``n_devices`` local devices; with fewer the
+    measurement respawns itself in a subprocess with
+    ``--xla_force_host_platform_device_count``."""
+    import os
+    import subprocess
+
+    if len(jax.devices()) < n_devices:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+        env["ML_TRAINER_TPU_PIPELINE_CHILD"] = "1"
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--pipeline",
+             "--pipeline-devices", str(n_devices)],
+            env=env, capture_output=True, text=True, timeout=1500,
+        )
+        result = None
+        for line in r.stdout.splitlines():
+            print(line, flush=True)  # re-surface the child's rows
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line).get("pipeline")
+                except ValueError:
+                    pass
+        if r.returncode != 0 or result is None:
+            tail = (r.stderr or "").strip().splitlines()
+            return {"error": f"pipeline worker failed (rc={r.returncode}): "
+                             f"{tail[-1] if tail else 'no stderr'}"}
+        if out_path:
+            _write_pipeline_artifact(result, out_path)
+        return result
+
+    import numpy as _np
+
+    from ml_trainer_tpu.parallel import create_mesh
+    from ml_trainer_tpu.parallel.comm_stats import (
+        comm_hop_bytes,
+        reset_comm_stats,
+    )
+    from ml_trainer_tpu.parallel.pipeline import (
+        pipeline_apply,
+        pipeline_schedule_info,
+        reset_pipeline_info,
+        stack_stage_params,
+    )
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def make_stack(n, seed):
+        rng = _np.random.default_rng(seed)
+        return stack_stage_params([
+            {"w": jnp.asarray(rng.normal(0, 0.5, (width, width)),
+                              jnp.float32),
+             "b": jnp.asarray(rng.normal(0, 0.1, (width,)), jnp.float32)}
+            for _ in range(n)
+        ])
+
+    rows = []
+    for S, V, M, schedule, remat in PIPELINE_MATRIX:
+        if S > n_devices:
+            continue
+        G = S * V
+        mesh = create_mesh({"stage": S}, devices=jax.devices()[:S])
+        stacked = make_stack(G, seed=G + M)
+        x = jnp.asarray(
+            _np.random.default_rng(M + S).normal(size=(M * mb_rows, width)),
+            jnp.float32,
+        )
+        reset_comm_stats()
+        reset_pipeline_info()
+
+        @jax.jit
+        def vag(p, x=x, mesh=mesh, M=M, schedule=schedule, V=V,
+                remat=remat):
+            return jax.value_and_grad(lambda pp: jnp.sum(pipeline_apply(
+                stage_fn, pp, x, mesh, n_microbatches=M,
+                schedule=schedule, n_virtual=V, remat=remat) ** 2))(p)
+
+        v, g = jax.block_until_ready(vag(stacked))
+        # Trajectory equality vs the serial fold (value AND grad).
+        def serial_loss(p):
+            def body(carry, pv):
+                return stage_fn(pv, carry), None
+            out, _ = jax.lax.scan(body, x, p)
+            return jnp.sum(out ** 2)
+
+        vs, gs = jax.value_and_grad(serial_loss)(stacked)
+        equal = bool(_np.isclose(float(v), float(vs), rtol=1e-5)) and all(
+            _np.allclose(_np.asarray(a), _np.asarray(b), atol=2e-4,
+                         rtol=1e-4)
+            for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(gs))
+        )
+        best = None
+        for _ in range(reps):
+            for _ in range(warmup):
+                jax.block_until_ready(vag(stacked))
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                jax.block_until_ready(vag(stacked))
+            dt = (time.perf_counter() - t0) / iters
+            best = dt if best is None else min(best, dt)
+        info = pipeline_schedule_info().get(schedule, {})
+        hops = {
+            h: round(v_, 1)
+            for h, v_ in comm_hop_bytes().get(schedule, {}).items()
+        }
+        row = {
+            "schedule": schedule, "n_stage_devices": S, "n_virtual": V,
+            "n_stages": G, "n_micro": M, "remat": remat,
+            "step_ms": round(best * 1e3, 3),
+            "serial_equal": equal,
+            "compiled_programs_constant": vag._cache_size() == 1,
+            "bubble_fraction": info.get("bubble_fraction"),
+            "wasted_compute_fraction": info.get("wasted_compute_fraction"),
+            "stash_slots": info.get("stash_slots"),
+            "comm_bytes_by_hop": hops,
+        }
+        rows.append(row)
+        print(
+            f"# pipeline S={S} V={V} M={M} {schedule:>11}/"
+            f"{'remat' if remat else 'store'} {row['step_ms']:>8.3f} ms  "
+            f"bubble {row['bubble_fraction']}  "
+            f"equal={'Y' if equal else 'N'}", flush=True,
+        )
+
+    def step_ms(schedule, S, M, remat=False):
+        for row in rows:
+            if (row["schedule"], row["n_stage_devices"], row["n_micro"],
+                    row["remat"]) == (schedule, S, M, remat):
+                return row["step_ms"]
+        return None
+
+    g48, f48 = step_ms("gpipe", 4, 8), step_ms("1f1b", 4, 8)
+    result = {
+        "kind": "pipeline schedule x stages matrix (value_and_grad of a "
+                f"{width}-wide tanh stage stack, {mb_rows}-row "
+                "microbatches)",
+        "n_devices": n_devices,
+        "backend": jax.default_backend(),
+        "rows": rows,
+        # Headline: >1.0 means 1F1B beats GPipe at the acceptance config.
+        "gpipe_over_1f1b_s4_m8": (
+            round(g48 / f48, 3) if g48 and f48 else None
+        ),
+        "gpipe_over_1f1b_s4_m8_remat": (
+            round((step_ms("gpipe", 4, 8, True) or 0)
+                  / step_ms("1f1b", 4, 8, True), 3)
+            if step_ms("1f1b", 4, 8, True) else None
+        ),
+    }
+    if out_path:
+        _write_pipeline_artifact(result, out_path)
+    return result
+
+
+def _write_pipeline_artifact(result, out_path) -> None:
+    import os
+
+    payload = dict(result)
+    payload["generated_by"] = "bench.py --pipeline"
+    payload["date"] = _utcnow()
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fp:
+        json.dump(payload, fp, indent=1)
+    os.replace(tmp, out_path)
+    print(f"# pipeline artifact -> {out_path}", flush=True)
+
+
 def bench_extended():
     """North-star table, one model per SUBPROCESS so a tunnel hang in any
     single model costs its per-model timeout, not the whole table (round
@@ -1386,6 +1588,16 @@ def main():
                         "docs/mixed_precision_cpu.json; CPU-safe)")
     parser.add_argument("--mixed-devices", type=int, default=8,
                         help="virtual device count for --mixed (default 8)")
+    parser.add_argument("--pipeline", action="store_true",
+                        help="run only the pipeline-schedule matrix: "
+                        "gpipe vs 1f1b vs interleaved vs zb step time, "
+                        "analytic bubble fractions, per-hop comm bytes "
+                        "and serial-fold equality on a virtual stage "
+                        "mesh (writes docs/pipeline_schedules_cpu.json; "
+                        "CPU-safe)")
+    parser.add_argument("--pipeline-devices", type=int, default=4,
+                        help="virtual device count for --pipeline "
+                        "(default 4)")
     parser.add_argument("--assume-up", action="store_true",
                         help="skip the --one pre-probe (used by --extended, "
                         "whose parent just probed — a second throwaway "
@@ -1474,6 +1686,24 @@ def main():
         )
         result = bench_mixed(n_devices=args.mixed_devices, out_path=out)
         print(json.dumps({"mixed": result}), flush=True)
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.pipeline:
+        # Pipeline-schedule matrix on virtual stage devices.  Like
+        # --mixed, the respawned child (env marker) must not write the
+        # artifact — its parent does, after validating the child's JSON.
+        import os as _os
+
+        child = _os.environ.get("ML_TRAINER_TPU_PIPELINE_CHILD") == "1"
+        out = None if child else _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "pipeline_schedules_cpu.json",
+        )
+        result = bench_pipeline(
+            n_devices=args.pipeline_devices, out_path=out
+        )
+        print(json.dumps({"pipeline": result}), flush=True)
         if result.get("error"):
             sys.exit(1)
         return

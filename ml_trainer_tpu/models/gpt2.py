@@ -245,6 +245,11 @@ class GPT2Pipelined(nn.Module):
     n_microbatches: int = 0  # 0 -> one microbatch per stage
     remat: bool = False  # recompute stage bodies in backward (O(1) ticks
     # of activation memory instead of O(S+M-1); math unchanged)
+    schedule: str = "gpipe"  # pipeline schedule (parallel.pipeline
+    # SCHEDULES: gpipe | 1f1b | interleaved | zb); same math, different
+    # WHERE/WHEN — the Trainer's `pipeline_schedule=` knob clones this.
+    n_virtual: int = 1  # interleaved only: virtual stages per device;
+    # the mesh's stage axis then spans n_stages // n_virtual devices.
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False):
@@ -281,6 +286,8 @@ class GPT2Pipelined(nn.Module):
                 stage_fn, blocks, x, self.mesh,
                 n_microbatches=self.n_microbatches or None,
                 remat=self.remat,
+                schedule=self.schedule,
+                n_virtual=self.n_virtual,
             )
         else:
             body = jax.checkpoint(stage_fn) if self.remat else stage_fn

@@ -39,7 +39,12 @@ from ml_trainer_tpu.parallel.sharding import (
 )
 from ml_trainer_tpu.parallel import collectives
 from ml_trainer_tpu.parallel.desync import check_desync, param_fingerprint
-from ml_trainer_tpu.parallel.pipeline import pipeline_apply, stack_stage_params
+from ml_trainer_tpu.parallel.pipeline import (
+    PIPELINE_SCHEDULES,
+    pipeline_apply,
+    pipeline_schedule_info,
+    stack_stage_params,
+)
 from ml_trainer_tpu.parallel.ring import ring_attention
 from ml_trainer_tpu.parallel.ulysses import ulysses_attention
 from ml_trainer_tpu.parallel.tp_rules import (
@@ -51,7 +56,9 @@ from ml_trainer_tpu.parallel.tp_rules import (
 __all__ = [
     "check_desync",
     "param_fingerprint",
+    "PIPELINE_SCHEDULES",
     "pipeline_apply",
+    "pipeline_schedule_info",
     "stack_stage_params",
     "ring_attention",
     "ulysses_attention",

@@ -55,6 +55,15 @@ _calls: Dict[str, int] = {}
 # on /metrics).  Keyed (op, bucket-label); mirrored into the registry as
 # ``comm_bucket_bytes_total{op=,bucket=}``.
 _bucket_bytes: Dict[Tuple[str, str], float] = {}
+# Per-hop breakdown for the pipeline schedules (the same view-not-ledger
+# pattern as buckets, one level up): forward activation hops, backward
+# cotangent hops, the recompute feed, and the output/input-grad
+# broadcasts are separately attributed per schedule, so a schedule that
+# moves more bytes than its tick table promises shows up on /metrics.
+# Keyed (schedule, hop-label); mirrored as
+# ``comm_hop_bytes_total{schedule=,hop=}``.
+_hop_bytes: Dict[Tuple[str, str], float] = {}
+_hop_calls: Dict[Tuple[str, str], int] = {}
 
 _FACTORS = {
     "psum": lambda size, n: 2.0 * size * (n - 1) / n,
@@ -135,15 +144,53 @@ def record_collective(op: str, n_bytes: float, calls: int = 1,
         pass
 
 
-def account(op: str, x, axis, times: int = 1, bucket: str = None) -> None:
+def record_hop(schedule: str, hop: str, n_bytes: float,
+               calls: int = 1) -> None:
+    """Accumulate ``n_bytes`` against one pipeline hop kind (``fwd`` /
+    ``bwd`` / ``fwd_recompute`` / ``output_broadcast`` /
+    ``grad_input_broadcast``) for ``schedule``, and mirror the running
+    total into the registry as ``comm_hop_bytes_total{schedule=,hop=}``
+    (a gauge, like the other comm mirrors, because ``reset_comm_stats``
+    legally zeroes it between bench legs).  The hop breakdown is a VIEW
+    beside the per-op totals — pipeline call sites record the same
+    bytes into both, so op totals already include hop traffic."""
+    key = (str(schedule), str(hop))
+    with _lock:
+        _hop_bytes[key] = _hop_bytes.get(key, 0.0) + float(n_bytes)
+        _hop_calls[key] = _hop_calls.get(key, 0) + int(calls)
+        b, c = _hop_bytes[key], _hop_calls[key]
+    try:
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        r = default_registry()
+        r.gauge(
+            "comm_hop_bytes_total",
+            "analytic bytes moved per pipeline-schedule hop kind "
+            "(trace-time)",
+            ("schedule", "hop"),
+        ).labels(schedule=key[0], hop=key[1]).set(b)
+        r.gauge(
+            "comm_hop_calls_total",
+            "executed hop count per pipeline-schedule hop kind",
+            ("schedule", "hop"),
+        ).labels(schedule=key[0], hop=key[1]).set(c)
+    except Exception:  # registry trouble must never break a trace
+        pass
+
+
+def account(op: str, x, axis, times: int = 1, bucket: str = None,
+            hop: Tuple[str, str] = None) -> None:
     """Trace-time accounting hook: compute the analytic byte count of one
     ``op`` over ``axis`` for input ``x`` and record it ``times`` times.
     ``times`` exists for collectives traced once inside a ``scan`` /
     ``fori_loop`` body but executed on every iteration — the loop owner
     tops the count up with the static trip count (ring attention rotates
-    K/V ``n`` times; the pipeline hops ``S+M-1`` ticks).  Best-effort by
-    design — any failure (untracked axis, abstract leaves) is swallowed
-    so the wrapped collective always executes unchanged."""
+    K/V ``n`` times; the pipeline hops ``S+M-1`` ticks).  ``hop`` is an
+    optional ``(schedule, hop_kind)`` pair that additionally lands the
+    same bytes in the per-hop pipeline breakdown (``record_hop``).
+    Best-effort by design — any failure (untracked axis, abstract
+    leaves) is swallowed so the wrapped collective always executes
+    unchanged."""
     try:
         from ml_trainer_tpu.parallel.compat import axis_size as _axis_size
 
@@ -153,10 +200,10 @@ def account(op: str, x, axis, times: int = 1, bucket: str = None) -> None:
                 n *= int(_axis_size(a))
         else:
             n = int(_axis_size(axis))
-        record_collective(
-            op, collective_bytes(op, _tree_bytes(x), n) * int(times),
-            calls=int(times), bucket=bucket,
-        )
+        n_bytes = collective_bytes(op, _tree_bytes(x), n) * int(times)
+        record_collective(op, n_bytes, calls=int(times), bucket=bucket)
+        if hop is not None:
+            record_hop(hop[0], hop[1], n_bytes, calls=int(times))
     except Exception:
         pass
 
@@ -179,6 +226,26 @@ def comm_bucket_bytes() -> Dict[str, Dict[str, float]]:
         out: Dict[str, Dict[str, float]] = {}
         for (op, bucket), b in _bucket_bytes.items():
             out.setdefault(op, {})[bucket] = b
+        return out
+
+
+def comm_hop_bytes() -> Dict[str, Dict[str, float]]:
+    """Per-hop cumulative analytic bytes of the pipeline schedules,
+    grouped by schedule: ``{schedule: {hop: bytes}}`` (copy; empty when
+    no pipeline ran)."""
+    with _lock:
+        out: Dict[str, Dict[str, float]] = {}
+        for (schedule, hop), b in _hop_bytes.items():
+            out.setdefault(schedule, {})[hop] = b
+        return out
+
+
+def comm_hop_calls() -> Dict[str, Dict[str, int]]:
+    """Executed hop counts, same grouping as :func:`comm_hop_bytes`."""
+    with _lock:
+        out: Dict[str, Dict[str, int]] = {}
+        for (schedule, hop), c in _hop_calls.items():
+            out.setdefault(schedule, {})[hop] = c
         return out
 
 
@@ -206,9 +273,12 @@ def reset_comm_stats() -> None:
     with _lock:
         ops: Tuple[str, ...] = tuple(_bytes)
         buckets = tuple(_bucket_bytes)
+        hops = tuple(_hop_bytes)
         _bytes.clear()
         _calls.clear()
         _bucket_bytes.clear()
+        _hop_bytes.clear()
+        _hop_calls.clear()
     try:
         from ml_trainer_tpu.telemetry.registry import default_registry
 
@@ -220,5 +290,12 @@ def reset_comm_stats() -> None:
             r.gauge(
                 "comm_bucket_bytes_total", "", ("op", "bucket")
             ).labels(op=op, bucket=bucket).set(0.0)
+        for schedule, hop in hops:
+            r.gauge(
+                "comm_hop_bytes_total", "", ("schedule", "hop")
+            ).labels(schedule=schedule, hop=hop).set(0.0)
+            r.gauge(
+                "comm_hop_calls_total", "", ("schedule", "hop")
+            ).labels(schedule=schedule, hop=hop).set(0.0)
     except Exception:
         pass

@@ -322,7 +322,9 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
         comm_bucket_bytes,
         comm_bytes,
         comm_calls,
+        comm_hop_bytes,
     )
+    from ml_trainer_tpu.parallel.pipeline import pipeline_schedule_info
 
     event_kinds = ("straggler", "desync", "rollback", "preemption",
                    "nonfinite_steps")
@@ -358,6 +360,16 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
             op: {b: round(v, 1) for b, v in bs.items()}
             for op, bs in comm_bucket_bytes().items()
         },
+        # Per-hop breakdown of the pipeline schedules (empty unless a
+        # pipelined model ran): {schedule: {fwd|bwd|fwd_recompute|
+        # output_broadcast|grad_input_broadcast: bytes}}.
+        "comm_bytes_by_hop": {
+            schedule: {h: round(v, 1) for h, v in hs.items()}
+            for schedule, hs in comm_hop_bytes().items()
+        },
+        # Analytic tick-table facts per traced pipeline schedule (bubble
+        # fractions, stash sizing — parallel/pipeline.py).
+        "pipeline_schedules": pipeline_schedule_info(),
         "resilience": {
             "skipped_steps": history.get("skipped_steps", []),
             "rollbacks": history.get("rollbacks", 0),

@@ -184,6 +184,10 @@ class TrainTelemetry:
             "train_loss_scale",
             "current dynamic loss scale (mixed precision; 0 = scaling off)",
         )
+        # The per-schedule train_pipeline_bubble_fraction{schedule=}
+        # gauge is owned by parallel/pipeline.py (set at trace time, the
+        # comm_stats discipline); on_sync only folds the active
+        # schedule's analytic bubble into the structured event.
 
     def on_sync(self, step: int, stats: dict, *, epoch: int = 0,
                 skipped_total: int = 0, lr_scale: float = 1.0,
@@ -280,6 +284,17 @@ class TrainTelemetry:
             event["comm_bytes_per_step"] = round(comm_b, 1)
         if comm_ratio is not None:
             event["comm_compute_ratio"] = comm_ratio
+        # Pipeline-parallel runs: surface the active schedule's analytic
+        # bubble (recorded at trace time by parallel/pipeline.py) beside
+        # the fenced step-time percentiles — the two halves of the
+        # measured-vs-analytic bubble comparison.
+        from ml_trainer_tpu.parallel.pipeline import pipeline_schedule_info
+
+        pinfo = pipeline_schedule_info()
+        if len(pinfo) == 1:  # exactly one schedule traced: unambiguous
+            (sched, info), = pinfo.items()
+            event["pipeline_schedule"] = sched
+            event["pipeline_bubble_fraction"] = info["bubble_fraction"]
         self.log.info("train_step_telemetry", **event)
         self.flight.record("train_step", **event)
         if skipped_d > 0:

@@ -202,6 +202,7 @@ class Trainer:
         loss_scale: Any = "dynamic",
         dp_update: str = "fused",
         bucket_mb: float = 4.0,
+        pipeline_schedule: Optional[str] = None,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -387,6 +388,17 @@ class Trainer:
         sharded path (default 4) — smaller buckets start communicating
         earlier but pay more per-collective latency.
 
+        ``pipeline_schedule``: override the pipeline-parallel schedule of
+        a pipelined model (``'gpipe'`` | ``'1f1b'`` | ``'interleaved'``
+        | ``'zb'`` — ``parallel.pipeline.SCHEDULES``; docs/pipeline.md).
+        The model must carry a ``schedule`` knob (``GPT2Pipelined``); it
+        is cloned with the override, exactly like the precision dtype
+        threading.  All schedules compute the same math — trajectories
+        are schedule-invariant (test-pinned) — so this knob only moves
+        WHERE/WHEN stage work runs: 1F1B bounds the activation stash,
+        interleaved shrinks the bubble by the model's ``n_virtual``.
+        ``None`` (default) keeps the model's own setting.
+
         ``handle_preemption`` (default True): ``fit()`` installs
         SIGTERM/SIGINT handlers (restored on exit) that finish the
         in-flight step, write an emergency mid-epoch checkpoint plus a
@@ -479,6 +491,14 @@ class Trainer:
             raise ValueError(f"bucket_mb must be positive, got {bucket_mb}")
         self.dp_update = dp_update
         self.bucket_mb = float(bucket_mb)
+        if pipeline_schedule is not None:
+            from ml_trainer_tpu.parallel.pipeline import SCHEDULES
+
+            if pipeline_schedule not in SCHEDULES:
+                raise ValueError(
+                    f"pipeline_schedule must be one of {SCHEDULES}, got "
+                    f"{pipeline_schedule!r}"
+                )
         if isinstance(model, str):
             model = get_model(model, precision=self.precision)
         elif (
@@ -492,6 +512,16 @@ class Trainer:
             # with the trainer-level policy; params stay fp32
             # (flax's separate param_dtype).
             model = model.clone(dtype=self._compute_dtype)
+        if pipeline_schedule is not None:
+            if not (hasattr(model, "schedule") and hasattr(model, "clone")):
+                raise ValueError(
+                    "pipeline_schedule requires a pipelined model with a "
+                    f"'schedule' knob (e.g. gpt2_pipe); got "
+                    f"{type(model).__name__}"
+                )
+            if model.schedule != pipeline_schedule:
+                model = model.clone(schedule=pipeline_schedule)
+        self.pipeline_schedule = pipeline_schedule
         self.model = model
         self._takes_train = _module_takes_train(model)
         self._takes_targets = _module_takes_targets(model)

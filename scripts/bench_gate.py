@@ -38,6 +38,14 @@ reduce-scatter + sharded update must hold the fused-psum rate at fp32
 (machine-independent floor), and the fp32 sharded samples/s ratchets
 against ``docs/mixed_precision_cpu.json`` / this machine's baseline.
 
+A fourth leg (``gate_pipeline``, skip with ``--skip-pipeline``) gates
+the PR8 pipeline schedules: serial-fold trajectory equality and the
+zero-recompile pin across every schedule row are hard invariants, the
+1F1B-vs-GPipe step-rate ratio at S=4/M=8 is the machine-independent
+floor, and the 1F1B steps/s ratchets against the committed
+``docs/pipeline_schedules_cpu.json`` artifact / this machine's
+baseline.
+
 Exit non-zero = regression.  Threshold override:
 ``ML_TRAINER_TPU_BENCH_GATE_THRESHOLD`` (fraction, e.g. ``0.15``).
 """
@@ -346,6 +354,101 @@ def gate_mixed(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_pipeline_reference(repo: str = REPO):
+    """1F1B S=4/M=8 steps/s from the committed pipeline-schedule matrix
+    (docs/pipeline_schedules_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "pipeline_schedules_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    for row in data.get("rows", []):
+        if (row.get("schedule"), row.get("n_stage_devices"),
+                row.get("n_micro"), row.get("remat")) == ("1f1b", 4, 8,
+                                                          False):
+            ms = row.get("step_ms")
+            if isinstance(ms, (int, float)) and ms > 0:
+                return 1e3 / float(ms), data
+    return None
+
+
+def gate_pipeline(threshold: float, backend: str, fp: str) -> dict:
+    """The pipeline-schedule regression gate (PR8): the schedule matrix
+    on a virtual 4-device stage mesh, gated three ways —
+
+    1. **Invariants** (hard): every schedule's value AND grad equal the
+       serial fold (the trajectory-equality discipline), and zero
+       recompiles on every row.
+    2. **1F1B-vs-GPipe ratio** (machine-independent): 1F1B must hold
+       >= ``1 - threshold`` of GPipe's step rate at S=4/M=8 (the
+       committed artifact shows it WINNING — GPipe burns bubble slots on
+       garbage compute; the gate's looser bound absorbs scheduler
+       noise).
+    3. **Trajectory/local baseline** on the 1F1B S=4/M=8 steps/s, with
+       the same calibrate-then-ratchet fallback the parity gate uses.
+    """
+    import bench
+
+    result = bench.bench_pipeline(iters=10, warmup=3, reps=1)
+    if result.get("error"):
+        return {"ok": False, "decided_by": "worker",
+                "error": result["error"]}
+    rows = result["rows"]
+    out = {
+        "gpipe_over_1f1b_s4_m8": result["gpipe_over_1f1b_s4_m8"],
+        "threshold": threshold,
+    }
+    bad = [r for r in rows if not r["serial_equal"]]
+    if bad:
+        out.update(
+            ok=False, decided_by="trajectory_equality",
+            error=f"{len(bad)} schedule row(s) diverged from the serial "
+            f"fold: {[(r['schedule'], r['n_stages'], r['n_micro'], r['remat']) for r in bad]}",
+        )
+        return out
+    bad = [r for r in rows if not r["compiled_programs_constant"]]
+    if bad:
+        out.update(ok=False, decided_by="zero_recompile",
+                   error="pipeline rows compiled new programs mid-run")
+        return out
+    ratio = result["gpipe_over_1f1b_s4_m8"]
+    if ratio is not None and ratio < 1.0 - threshold:
+        out.update(
+            ok=False, decided_by="1f1b_vs_gpipe",
+            error=f"1f1b at {ratio:.2f}x gpipe step rate at S=4/M=8 "
+            f"(floor {1.0 - threshold:.2f}x)",
+        )
+        return out
+    f1 = next(
+        (r for r in rows
+         if (r["schedule"], r["n_stage_devices"], r["n_micro"],
+             r["remat"]) == ("1f1b", 4, 8, False)), None,
+    )
+    if f1 is None or not f1.get("step_ms"):
+        out.update(ok=False, decided_by="worker",
+                   error="1f1b S=4/M=8 row missing from the matrix")
+        return out
+    fresh = 1e3 / float(f1["step_ms"])
+    out["f1b_steps_per_sec"] = round(fresh, 1)
+    committed = committed_pipeline_reference()
+    key = f"{backend}_train_pipeline"
+    baseline = load_baseline(key, fp)
+    decision = evaluate(
+        fresh, committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(key, fp, max(fresh, baseline or 0.0))
+    elif "error" not in out:
+        out["error"] = (
+            f"1f1b {round(fresh, 1)} steps/s is >{threshold * 100:.0f}% "
+            f"below this machine's baseline {baseline}"
+        )
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--threshold", type=float, default=float(
@@ -361,6 +464,8 @@ def main() -> int:
     parser.add_argument("--skip-mixed", action="store_true",
                         help="skip the mixed-precision / sharded-update "
                         "gate")
+    parser.add_argument("--skip-pipeline", action="store_true",
+                        help="skip the pipeline-schedule gate")
     args = parser.parse_args()
 
     import jax
@@ -428,6 +533,19 @@ def main() -> int:
             f"BENCH_GATE MIXED OK ({mixed['decided_by']}): sharded update "
             f"{mixed['sharded_vs_fused_fp32']}x fused at fp32, "
             f"{mixed['sharded_vs_fused_bf16']}x at bf16",
+            flush=True,
+        )
+    if not args.skip_pipeline:
+        pipe = gate_pipeline(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_pipeline": pipe}), flush=True)
+        if not pipe["ok"]:
+            print(f"BENCH_GATE PIPELINE FAIL: {pipe.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE PIPELINE OK ({pipe['decided_by']}): 1f1b at "
+            f"{pipe['gpipe_over_1f1b_s4_m8']}x gpipe step rate "
+            f"(S=4/M=8), {pipe.get('f1b_steps_per_sec')} steps/s",
             flush=True,
         )
     return 0

@@ -11,14 +11,19 @@
 # + prefix cache + preempt-requeue stack end to end, a mixed-precision /
 # sharded-update smoke leg (scripts/mixed_smoke.py: 2-virtual-device
 # bucketed-overlap + bf16 dryrun, zero recompiles, finite loss,
-# overflow-backoff semantics), and a bench regression gate
-# (scripts/bench_gate.py) that fails on >10% samples/s regression vs
-# the committed BENCH trajectory / this machine's calibrated baseline —
-# plus the paged-serving replay gate (byte identity, zero-recompile,
-# paged-vs-contiguous ratio, tokens/s ratchet vs
-# docs/serving_replay_cpu.json) and the mixed gate (finite/zero-recompile
+# overflow-backoff semantics), a pipeline-schedule smoke leg
+# (scripts/pipeline_smoke.py: 1F1B + interleaved through the real
+# Trainer on a 2-virtual-device stage mesh, serial-fold trajectory
+# equality, zero recompiles, per-hop comm + bubble gauges), and a bench
+# regression gate (scripts/bench_gate.py) that fails on >10% samples/s
+# regression vs the committed BENCH trajectory / this machine's
+# calibrated baseline — plus the paged-serving replay gate (byte
+# identity, zero-recompile, paged-vs-contiguous ratio, tokens/s ratchet
+# vs docs/serving_replay_cpu.json), the mixed gate (finite/zero-recompile
 # invariants, sharded>=fused floor, ratchet vs
-# docs/mixed_precision_cpu.json).
+# docs/mixed_precision_cpu.json), and the pipeline gate (trajectory
+# equality + zero-recompile invariants, 1f1b>=gpipe floor at S=4/M=8,
+# ratchet vs docs/pipeline_schedules_cpu.json).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -47,8 +52,12 @@ echo "# mixed-precision / sharded-update smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/mixed_smoke.py
 mixed_rc=$?
 [ $mixed_rc -ne 0 ] && echo "# mixed smoke FAILED (rc=$mixed_rc)"
+echo "# pipeline-schedule smoke leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/pipeline_smoke.py
+pipeline_rc=$?
+[ $pipeline_rc -ne 0 ] && echo "# pipeline smoke FAILED (rc=$pipeline_rc)"
 echo "# bench regression gate"
-timeout -k 10 780 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 900 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -56,5 +65,6 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$telemetry_rc
 [ $rc -eq 0 ] && rc=$paged_rc
 [ $rc -eq 0 ] && rc=$mixed_rc
+[ $rc -eq 0 ] && rc=$pipeline_rc
 [ $rc -eq 0 ] && rc=$gate_rc
 exit $rc
