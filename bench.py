@@ -883,6 +883,13 @@ def bench_one_model(name: str, batch_size: int | None = None) -> dict:
     # MFU only means something against the real chip's peak.
     on_tpu = jax.default_backend() == "tpu"
     mfu = rate * flops / _chip_peak_flops() if (flops and on_tpu) else None
+    # HBM columns (telemetry/memory.py): the LIVE per-device peak (TPU
+    # allocator stats; live-array accounting on CPU, which cannot see
+    # XLA's scratch arena) beside the ANALYTIC ledger's peak prediction.
+    from ml_trainer_tpu.telemetry import memory as _memory
+
+    mem_live = _memory.live_memory_snapshot()
+    mem_ledger = _memory.bench_step_ledger(state, model, (x, y))
     return {
         "model": name, "batch_shape": list(shape),
         "samples_per_sec": round(rate * shape[0], 1),
@@ -891,6 +898,10 @@ def bench_one_model(name: str, batch_size: int | None = None) -> dict:
         "mfu": round(mfu, 4) if mfu is not None else None,
         "flops_per_step": flops,
         "flops_source": flops_source if flops else None,
+        "peak_hbm_bytes": int(mem_live["max_peak_bytes_in_use"]),
+        "peak_hbm_source": mem_live["source"],
+        "analytic_hbm_bytes": int(mem_ledger.peak_bytes()),
+        "analytic_hbm_resident_bytes": int(mem_ledger.resident_bytes()),
         # mfu can be null on a healthy TPU run (cost analysis unavailable),
         # so the row records the backend explicitly — recovery's done-check
         # must not confuse a CPU-fallback row with a TPU measurement.
@@ -1535,6 +1546,62 @@ def bench_extended():
     return out
 
 
+def bench_memplan(args) -> dict:
+    """``--memplan``: the analytic fit-or-OOM planner.  Prices a model ×
+    batch × parallelism config per device (telemetry/memory.py formula
+    walk — ``jax.eval_shape`` only) and judges the predicted peak
+    against the chip HBM capacity table (telemetry/flops.py)."""
+    from ml_trainer_tpu.models.registry import get_model
+    from ml_trainer_tpu.telemetry import memory as _memory
+
+    mesh_shape = {}
+    for part in (args.memplan_mesh or "").split(","):
+        if part.strip():
+            axis, _, n = part.partition("=")
+            mesh_shape[axis.strip()] = int(n)
+    name = args.memplan
+    model = get_model(
+        name, **(EXTENDED_CONFIGS[name][2]() if name in EXTENDED_CONFIGS
+                 else {})
+    )
+    batch = args.batch_size or (
+        EXTENDED_CONFIGS[name][0][0] if name in EXTENDED_CONFIGS else 32
+    )
+    if name in EXTENDED_CONFIGS:
+        shape = (batch,) + tuple(EXTENDED_CONFIGS[name][0][1:])
+    elif getattr(model, "max_len", 0):
+        shape = (batch, args.memplan_seq or int(model.max_len))
+    else:
+        shape = (batch, 32, 32, 3)
+    ledger = _memory.plan_train_memory(
+        model, shape,
+        optimizer=args.memplan_optimizer,
+        mesh_shape=mesh_shape,
+        shard_opt_state=args.memplan_zero1,
+        precision=args.memplan_precision,
+    )
+    verdict = _memory.fit_verdict(ledger.peak_bytes())
+    for c in ledger.components:
+        print(f"# {c.name:<18} {c.bytes / 2 ** 20:10.2f} MiB  ({c.kind})",
+              file=sys.stderr)
+    print(
+        f"# peak {ledger.peak_bytes() / 2 ** 30:.2f} GiB vs "
+        f"{verdict['chip']} capacity "
+        f"{verdict['capacity_bytes'] / 2 ** 30:.0f} GiB -> "
+        f"{verdict['verdict'].upper()}",
+        file=sys.stderr,
+    )
+    return {
+        "model": name, "batch_shape": list(shape),
+        "mesh": mesh_shape or {"data": 1},
+        "optimizer": args.memplan_optimizer,
+        "zero1": bool(args.memplan_zero1),
+        "precision": args.memplan_precision,
+        "ledger": ledger.as_dict(),
+        "fit": verdict,
+    }
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--extended", action="store_true",
@@ -1598,6 +1665,27 @@ def main():
     parser.add_argument("--pipeline-devices", type=int, default=4,
                         help="virtual device count for --pipeline "
                         "(default 4)")
+    parser.add_argument("--memplan", metavar="MODEL", default=None,
+                        help="fit-or-OOM planner (telemetry/memory.py): "
+                        "analytic per-device HBM ledger for MODEL under "
+                        "the given knobs, judged against the chip's HBM "
+                        "capacity — no state is built, no device memory "
+                        "touched (CPU-safe; works for topologies this "
+                        "host does not have)")
+    parser.add_argument("--memplan-mesh", default="",
+                        help="mesh for --memplan as 'data=8' or "
+                        "'data=4,tensor=2' (default: single device)")
+    parser.add_argument("--memplan-optimizer", default="adamw",
+                        help="optimizer whose moments the --memplan "
+                        "ledger prices (default adamw)")
+    parser.add_argument("--memplan-zero1", action="store_true",
+                        help="price ZeRO-1 moment sharding (÷data) in "
+                        "--memplan")
+    parser.add_argument("--memplan-precision", default=None,
+                        help="compute precision for --memplan (e.g. bf16)")
+    parser.add_argument("--memplan-seq", type=int, default=None,
+                        help="sequence length override for --memplan LM "
+                        "models (default: the model's max_len)")
     parser.add_argument("--assume-up", action="store_true",
                         help="skip the --one pre-probe (used by --extended, "
                         "whose parent just probed — a second throwaway "
@@ -1614,6 +1702,9 @@ def main():
     args = parser.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if args.memplan:
+        print(json.dumps({"memplan": bench_memplan(args)}, indent=1))
+        return
     if not args.one:
         args.batch_size = args.batch_size or 32
     if args.one:

@@ -126,6 +126,11 @@ def _record_wait(secs: float) -> None:
     with _wait_lock:
         _wait_secs += secs
         _wait_batches += 1
+    # Goodput ledger (telemetry/goodput.py): host seconds blocked in the
+    # input pipeline are non-compute wall-clock by definition.
+    from ml_trainer_tpu.telemetry import goodput
+
+    goodput.account("data_wait", secs)
 
 
 def prefetch_to_device(
@@ -184,8 +189,10 @@ def prefetch_to_device(
         _record_wait(time.perf_counter() - t0)
         return batch
 
+    from ml_trainer_tpu.telemetry import goodput
+
     def put_spanned(batch):
-        with span("h2d"):
+        with span("h2d"), goodput.timed("h2d"):
             return put(batch)
 
     for batch in itertools.islice(it, size):

@@ -540,6 +540,22 @@ class SlotDecodeEngine:
         self.pool.reset_slot(slot)
         self._push_kv_metrics()
 
+    def _kv_bytes_per_page(self) -> int:
+        """Device bytes of ONE pool page across every layer's K and V:
+        the page-geometry × dtype pricing behind
+        ``serving_kv_pool_bytes{state=}`` (cached; the pool leaves are
+        the cache entries whose leading dim is the page count)."""
+        cached = getattr(self, "_bytes_per_page", None)
+        if cached is not None:
+            return cached
+        pool_bytes = sum(
+            int(l.nbytes)
+            for l in jax.tree.leaves(self.cache)
+            if getattr(l, "ndim", 0) >= 1 and l.shape[0] == self.kv_pages
+        )
+        self._bytes_per_page = pool_bytes // max(self.kv_pages, 1)
+        return self._bytes_per_page
+
     def _push_kv_metrics(self) -> None:
         if not self.paged:
             return
@@ -547,6 +563,7 @@ class SlotDecodeEngine:
             self.pool.free_count(), self.pool.used_count(),
             self.kv_pages - 1,
             len(self._prefix) if self._prefix is not None else 0,
+            bytes_per_page=self._kv_bytes_per_page(),
         )
         if self._prefix is not None:
             self.metrics.record_prefix_stats(

@@ -87,6 +87,10 @@ class ServingMetrics:
         self.kv_pages_total = 0
         self.kv_pages_free = 0
         self.kv_pages_used = 0
+        # Device bytes of one KV page (page geometry × dtype × layers ×
+        # K/V), so the pool gauges price in bytes as well as pages — the
+        # hook the HBM ledger (telemetry/memory.py) reads.
+        self.kv_page_bytes = 0
         self.prefix_cache_nodes = 0
         self.prefix_hits = 0
         self.prefix_misses = 0
@@ -205,14 +209,19 @@ class ServingMetrics:
             self.admissions_blocked += 1
 
     def record_kv(self, free: int, used: int, total: int,
-                  prefix_nodes: int) -> None:
+                  prefix_nodes: int,
+                  bytes_per_page: Optional[int] = None) -> None:
         """Paged-pool occupancy snapshot (allocatable pages — the trash
-        page is excluded from ``total``)."""
+        page is excluded from ``total``).  ``bytes_per_page`` (device
+        bytes of one page across all layers, K and V) turns the page
+        counts into ``serving_kv_pool_bytes{state=}`` gauges."""
         with self._lock:
             self.kv_pages_free = int(free)
             self.kv_pages_used = int(used)
             self.kv_pages_total = int(total)
             self.prefix_cache_nodes = int(prefix_nodes)
+            if bytes_per_page is not None:
+                self.kv_page_bytes = int(bytes_per_page)
 
     def record_prefix_stats(self, hits: int, misses: int,
                             hit_tokens: int, lookup_tokens: int) -> None:
@@ -285,6 +294,13 @@ class ServingMetrics:
                 "kv_pages_total": self.kv_pages_total,
                 "kv_pages_free": self.kv_pages_free,
                 "kv_pages_used": self.kv_pages_used,
+                # Page counts priced in device bytes (geometry × dtype):
+                # the serving end of the HBM ledger.
+                "kv_pool_bytes": {
+                    "free": self.kv_pages_free * self.kv_page_bytes,
+                    "used": self.kv_pages_used * self.kv_page_bytes,
+                    "total": self.kv_pages_total * self.kv_page_bytes,
+                },
                 "prefix_cache_nodes": self.prefix_cache_nodes,
                 "prefix_hits": self.prefix_hits,
                 "prefix_misses": self.prefix_misses,
@@ -358,6 +374,18 @@ class ServingMetrics:
                             f"per-tenant {fname}",
                             labelnames=("tenant",),
                         ).labels(tenant=tenant).set(float(fval))
+                continue
+            if key == "kv_pool_bytes":
+                # Labeled by pool state, next to the kv_pages_* gauges,
+                # so one scrape prices the serving engine's HBM.
+                g = r.gauge(
+                    "serving_kv_pool_bytes",
+                    "paged KV pool device bytes by state "
+                    "(page geometry x dtype x layers x K/V)",
+                    labelnames=("state",),
+                )
+                for state_name, v in value.items():
+                    g.labels(state=state_name).set(float(v))
                 continue
             if key == "spec_accept_hist":
                 h = r.histogram(

@@ -29,6 +29,13 @@ Four parts (docs/observability.md):
   (``parallel/desync.py`` publishes fingerprints here), analytic
   collective-comms accounting (``parallel/comm_stats.py``), and the
   end-of-run ``run_report.json``/``.md`` distillation (``cluster.py``).
+* **memory / goodput / recompile pillar** — the analytic per-device
+  HBM ledger with live cross-check and fit-or-OOM planner
+  (``memory.py``), the wall-clock-decomposition goodput ledger behind
+  ``train_goodput_fraction`` (``goodput.py``), and compile forensics on
+  JAX's own compilation path — ``compile_events_total{fn=}``, flight
+  ``recompile`` events naming the offending shape
+  (``compile_watch.py``).
 """
 
 from ml_trainer_tpu.telemetry.cluster import (
@@ -42,10 +49,19 @@ from ml_trainer_tpu.telemetry.flight import (
     FlightRecorder,
     get_recorder,
 )
+from ml_trainer_tpu.telemetry import compile_watch, goodput, memory
 from ml_trainer_tpu.telemetry.flops import (
+    chip_hbm_capacity_bytes,
     chip_peak_flops,
     chip_peak_hbm_bytes,
     train_step_flops,
+)
+from ml_trainer_tpu.telemetry.goodput import GoodputMeter
+from ml_trainer_tpu.telemetry.memory import (
+    MemoryLedger,
+    live_memory_snapshot,
+    plan_train_memory,
+    train_ledger,
 )
 from ml_trainer_tpu.telemetry.registry import (
     Counter,
@@ -79,7 +95,16 @@ __all__ = [
     "FLIGHT_DIR_ENV",
     "chip_peak_flops",
     "chip_peak_hbm_bytes",
+    "chip_hbm_capacity_bytes",
     "train_step_flops",
+    "compile_watch",
+    "goodput",
+    "memory",
+    "GoodputMeter",
+    "MemoryLedger",
+    "live_memory_snapshot",
+    "plan_train_memory",
+    "train_ledger",
     "TrainTelemetry",
     "ClusterTelemetry",
     "HEARTBEAT_FIELDS",

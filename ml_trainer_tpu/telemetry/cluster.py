@@ -58,6 +58,12 @@ HEARTBEAT_FIELDS = (
     "samples_per_sec",
     "skipped_steps_total",
     "comm_bytes_total",
+    # Per-host goodput (telemetry/goodput.py): the fraction of this
+    # host's wall-clock spent in productive train compute — a pod host
+    # whose goodput sags while its step p50 holds is stalling OUTSIDE
+    # the step (input, checkpoints, compiles), which the step
+    # percentiles alone cannot show.
+    "goodput_fraction",
 )
 
 
@@ -204,6 +210,63 @@ class ClusterTelemetry:
 
 
 # ---------------------------------------------------------------- report
+def _labeled(snap: dict, prefix: str) -> Dict[str, float]:
+    """Parse ``name{label=value}`` gauge keys back into value -> number."""
+    out: Dict[str, float] = {}
+    head = prefix + "{"
+    for k, v in snap.items():
+        if k.startswith(head) and k.endswith("}"):
+            label = k[len(head):-1].split("=", 1)[-1]
+            out[label] = v
+    return out
+
+
+def _goodput_section(snap: dict) -> dict:
+    buckets = {
+        b: round(v, 3)
+        for b, v in _labeled(snap, "train_goodput_seconds_total").items()
+    }
+    out = {"buckets_secs": buckets}
+    for key, name in (
+        ("train_goodput_fraction", "goodput_fraction"),
+        ("train_goodput_compute_seconds_total", "compute_secs"),
+    ):
+        if key in snap:
+            out[name] = round(snap[key], 4)
+    return out
+
+
+def _memory_section(snap: dict) -> dict:
+    out: dict = {}
+    comp = _labeled(snap, "mem_analytic_bytes")
+    if comp:
+        out["analytic_components"] = {k: int(v) for k, v in comp.items()}
+    for key in ("mem_analytic_resident_bytes", "mem_analytic_peak_bytes"):
+        if key in snap:
+            out[key[4:]] = int(snap[key])
+    live = _labeled(snap, "mem_live_bytes")
+    if live:
+        out["live_bytes_by_device"] = {k: int(v) for k, v in live.items()}
+    peak = _labeled(snap, "mem_live_peak_bytes")
+    if peak:
+        out["live_peak_bytes_by_device"] = {
+            k: int(v) for k, v in peak.items()
+        }
+    return out
+
+
+def _compile_section(snap: dict) -> dict:
+    out: dict = {
+        "by_fn": {
+            k: int(v) for k, v in _labeled(snap, "compile_events_total").items()
+        },
+    }
+    out["total"] = int(sum(out["by_fn"].values()))
+    if "compile_events_post_warmup_total" in snap:
+        out["post_warmup"] = int(snap["compile_events_post_warmup_total"])
+    return out
+
+
 def _ckpt_write_stats() -> dict:
     """Checkpoint write-time stats harvested from the span buffer."""
     from ml_trainer_tpu.telemetry.spans import trace_events
@@ -260,6 +323,33 @@ def _markdown_report(report: dict) -> str:
             lines.append(f"| {op} | {int(comm[op]):,} |")
     else:
         lines.append("no explicit collectives traced")
+    gp = report.get("goodput", {})
+    if gp.get("buckets_secs") or "goodput_fraction" in gp:
+        lines += ["", "## Goodput", ""]
+        if "goodput_fraction" in gp:
+            lines.append(f"* goodput fraction: {gp['goodput_fraction']}")
+        if "compute_secs" in gp:
+            lines.append(f"* compute seconds: {gp['compute_secs']}")
+        for b, v in sorted(gp.get("buckets_secs", {}).items()):
+            lines.append(f"* {b}: {v}s")
+    mem = report.get("memory", {})
+    if mem.get("analytic_components"):
+        lines += ["", "## Memory ledger (analytic, per device)", ""]
+        lines.append("| component | bytes |")
+        lines.append("|---|---|")
+        for c, b in sorted(mem["analytic_components"].items()):
+            lines.append(f"| {c} | {int(b):,} |")
+        for key in ("analytic_resident_bytes", "analytic_peak_bytes"):
+            if key in mem:
+                lines.append(f"| {key} | {int(mem[key]):,} |")
+    comp = report.get("compiles", {})
+    if comp.get("total"):
+        lines += [
+            "", "## Compiles", "",
+            f"* total: {comp['total']}"
+            + (f", post-warmup: {comp['post_warmup']}"
+               if comp.get("post_warmup") else ""),
+        ]
     res = report.get("resilience", {})
     lines += [
         "",
@@ -376,6 +466,15 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
             "straggler_events": straggler_events,
             "desync_events": desync_events,
         },
+        # Wall-clock decomposition (telemetry/goodput.py): where the
+        # run's time went, and the goodput fraction that summarizes it.
+        "goodput": _goodput_section(snap),
+        # HBM ledger (telemetry/memory.py): analytic per-component bytes
+        # beside the live per-device view.
+        "memory": _memory_section(snap),
+        # Recompile forensics (telemetry/compile_watch.py): compile
+        # counts by function; post-warmup compiles are incidents.
+        "compiles": _compile_section(snap),
         "checkpoint_writes": _ckpt_write_stats(),
         "history": {
             k: history[k]

@@ -1,0 +1,357 @@
+"""Recompile forensics: every XLA compile, named, timed, and explained.
+
+A TPU run that recompiles in steady state is a production incident — a
+shape leaked into a traced argument, a Python float toggled weak_type,
+a cache key drifted — and the symptom (a multi-second stall every N
+steps) points nowhere near the cause.  The repo used to pin "no silent
+recompiles" through ad-hoc ``jit._cache_size() == 1`` asserts scattered
+across tests and smoke scripts; this module replaces those with one
+real instrument on JAX's own compilation path:
+
+* every backend compile is recorded as a :class:`CompileEvent` —
+  function name, elapsed ms, timestamp — and counted in the registry as
+  ``compile_events_total{fn=...}``;
+* the tracing-cache-miss explanation JAX can produce
+  (``jax_explain_cache_misses``) is captured and attached to the next
+  compile event, so a post-warmup recompile names the offending
+  argument and shape (``"at x, seen f32[4], but now given f32[8]"``);
+* after :func:`mark_warm` (the Trainer calls it once its first epoch —
+  train + eval — has compiled everything it legitimately needs), each
+  further compile ALSO fires a flight-recorder ``recompile`` event and
+  bumps ``compile_events_post_warmup_total``, so an OOM/wedge dump
+  shows the compile storm right next to the steps it stalled;
+* compile seconds feed the goodput ledger's ``compile`` bucket
+  (``telemetry/goodput.py``) — wall-clock attribution, not just counts.
+
+Mechanism: :func:`install` wraps ``jax._src.dispatch.log_elapsed_time``
+(the one funnel both the pjit and pmap lowering paths time their
+backend compiles through — looked up as a module attribute at call
+time, so the wrap takes effect everywhere) and registers a capture
+handler on the ``jax._src.pjit`` logger for the cache-miss
+explanations.  If a future jax moves the funnel, ``install`` degrades
+to the public ``jax.monitoring`` duration listener — counts and
+elapsed survive, function names become ``"unknown"``.  The observed
+programs are untouched: this is pure host-side bookkeeping, so the
+compiled-step trajectory stays bit-identical with the watch installed
+(test-pinned).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ml_trainer_tpu.utils.logging import get_logger
+
+logger = get_logger("ml_trainer_tpu.telemetry")
+
+# The jax.monitoring key the backend-compile timer records under —
+# public, stable across 0.4.x (jax._src.dispatch.BACKEND_COMPILE_EVENT).
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_MAX_EVENTS = 512  # bounded ring; a compile storm must not grow the host
+_MAX_EXPLANATION = 2000  # chars kept of a cache-miss explanation
+
+
+@dataclasses.dataclass
+class CompileEvent:
+    """One backend (XLA) compile."""
+
+    seq: int
+    fn: str
+    elapsed_ms: float
+    t: float  # time.time() at completion
+    after_warmup: bool
+    explanation: Optional[str] = None  # tracing-cache-miss forensics
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["elapsed_ms"] = round(d["elapsed_ms"], 3)
+        return d
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.installed = False
+        self.mode = "off"  # "patched" | "monitoring" | "off"
+        self.events: List[CompileEvent] = []
+        self.seq = 0
+        self.total = 0
+        self.post_warmup = 0
+        self.warm = False
+        self.by_fn: Dict[str, int] = {}
+        self.pending_explanation: Optional[str] = None
+        self.orig_log_elapsed = None
+        self.explain_handler: Optional[logging.Handler] = None
+        self.explain_prev_propagate: Optional[bool] = None
+        self.explain_prev_config: Optional[bool] = None
+
+
+_state = _State()
+
+
+class _ExplainHandler(logging.Handler):
+    """Captures ``TRACING CACHE MISS`` explanations (jax._src.pjit logs
+    them at WARNING when ``jax_explain_cache_misses`` is on) so the next
+    compile event can name the offending argument/shape."""
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        if "TRACING CACHE MISS" not in msg:
+            return
+        with _state.lock:
+            _state.pending_explanation = msg[:_MAX_EXPLANATION]
+
+
+def _on_compile(fn: str, elapsed_s: float) -> None:
+    """One finished backend compile: ring + counters + (post-warmup)
+    flight forensics + the goodput ledger's compile bucket."""
+    now = time.time()
+    with _state.lock:
+        _state.seq += 1
+        _state.total += 1
+        _state.by_fn[fn] = _state.by_fn.get(fn, 0) + 1
+        warm = _state.warm
+        if warm:
+            _state.post_warmup += 1
+        explanation, _state.pending_explanation = (
+            _state.pending_explanation, None
+        )
+        ev = CompileEvent(
+            seq=_state.seq, fn=fn, elapsed_ms=elapsed_s * 1e3, t=now,
+            after_warmup=warm, explanation=explanation,
+        )
+        _state.events.append(ev)
+        del _state.events[:-_MAX_EVENTS]
+    # Registry + goodput + flight OUTSIDE the lock (they take their own).
+    try:
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        r = default_registry()
+        r.counter(
+            "compile_events_total",
+            "XLA backend compiles observed this process",
+            ("fn",),
+        ).labels(fn=fn).inc()
+        if warm:
+            r.counter(
+                "compile_events_post_warmup_total",
+                "compiles AFTER the owning loop declared warmup done — "
+                "each one is a steady-state recompile to investigate",
+            ).inc()
+    except Exception:  # the instrument must never break a compile
+        pass
+    try:
+        from ml_trainer_tpu.telemetry import goodput
+
+        goodput.account("compile", elapsed_s)
+    except Exception:
+        pass
+    if warm:
+        try:
+            from ml_trainer_tpu.telemetry.flight import get_recorder
+
+            get_recorder().record(
+                "recompile", fn=fn, elapsed_ms=round(elapsed_s * 1e3, 3),
+                explanation=explanation,
+            )
+        except Exception:
+            pass
+        logger.warning(
+            f"post-warmup recompile: {fn} ({elapsed_s * 1e3:.1f}ms)"
+            + (f"\n{explanation}" if explanation else "")
+        )
+
+
+def _patched_log_elapsed_time(orig):
+    @contextlib.contextmanager
+    def wrapped(fmt, fun_name, event=None):
+        t0 = time.perf_counter()
+        with orig(fmt, fun_name, event=event):
+            yield
+        if event == BACKEND_COMPILE_EVENT:
+            _on_compile(str(fun_name), time.perf_counter() - t0)
+
+    return wrapped
+
+
+def install() -> str:
+    """Install the compile watch (idempotent).  Returns the active mode:
+    ``"patched"`` (full forensics) or ``"monitoring"`` (counts + elapsed
+    only — the jax internals moved)."""
+    with _state.lock:
+        if _state.installed:
+            return _state.mode
+        _state.installed = True
+    import jax
+
+    mode = "monitoring"
+    try:
+        from jax._src import dispatch as _dispatch
+
+        orig = _dispatch.log_elapsed_time
+        _dispatch.log_elapsed_time = _patched_log_elapsed_time(orig)
+        _state.orig_log_elapsed = orig
+        mode = "patched"
+    except Exception as e:
+        logger.warning(
+            f"compile watch: jax internals moved ({e}); falling back to "
+            "the monitoring listener (no function names)"
+        )
+        import jax.monitoring as _mon
+
+        def _listener(key, dur, **kw):
+            if key == BACKEND_COMPILE_EVENT:
+                _on_compile("unknown", float(dur))
+
+        _mon.register_event_duration_secs_listener(_listener)
+    # Cache-miss explanations: jax logs them (WARNING, jax._src.pjit)
+    # when the flag is on; our handler captures, propagation is silenced
+    # while installed so every first-seen-function trace does not spam
+    # the user's log (uninstall restores both).
+    try:
+        plog = logging.getLogger("jax._src.pjit")
+        handler = _ExplainHandler()
+        plog.addHandler(handler)
+        _state.explain_handler = handler
+        _state.explain_prev_propagate = plog.propagate
+        plog.propagate = False
+        _state.explain_prev_config = bool(
+            jax.config.jax_explain_cache_misses
+        )
+        jax.config.update("jax_explain_cache_misses", True)
+    except Exception:
+        _state.explain_handler = None
+    _state.mode = mode
+    logger.info(f"compile watch installed (mode={mode})")
+    return mode
+
+
+def uninstall() -> None:
+    """Remove the watch and restore jax's hooks (tests only)."""
+    with _state.lock:
+        if not _state.installed:
+            return
+        _state.installed = False
+        _state.mode = "off"
+    if _state.orig_log_elapsed is not None:
+        try:
+            from jax._src import dispatch as _dispatch
+
+            _dispatch.log_elapsed_time = _state.orig_log_elapsed
+        except Exception:
+            pass
+        _state.orig_log_elapsed = None
+    if _state.explain_handler is not None:
+        try:
+            import jax
+
+            plog = logging.getLogger("jax._src.pjit")
+            plog.removeHandler(_state.explain_handler)
+            if _state.explain_prev_propagate is not None:
+                plog.propagate = _state.explain_prev_propagate
+            if _state.explain_prev_config is not None:
+                jax.config.update(
+                    "jax_explain_cache_misses", _state.explain_prev_config
+                )
+        except Exception:
+            pass
+        _state.explain_handler = None
+
+
+def installed() -> bool:
+    with _state.lock:
+        return _state.installed
+
+
+def mark_warm() -> None:
+    """Declare warmup over: every compile from here on is a steady-state
+    recompile (flight ``recompile`` event + post-warmup counter)."""
+    with _state.lock:
+        _state.warm = True
+
+
+def mark_cold() -> None:
+    """Re-open warmup (a new model/config is about to compile on
+    purpose — e.g. a second Trainer in the same process)."""
+    with _state.lock:
+        _state.warm = False
+
+
+def is_warm() -> bool:
+    with _state.lock:
+        return _state.warm
+
+
+def compile_count(fn: Optional[str] = None) -> int:
+    """Total compiles observed (optionally for one function label)."""
+    with _state.lock:
+        if fn is None:
+            return _state.total
+        return _state.by_fn.get(fn, 0)
+
+
+def post_warmup_count() -> int:
+    with _state.lock:
+        return _state.post_warmup
+
+
+def counts_by_fn() -> Dict[str, int]:
+    with _state.lock:
+        return dict(_state.by_fn)
+
+
+def events(last: Optional[int] = None) -> List[CompileEvent]:
+    """The recorded compile events, oldest first (``last`` trims)."""
+    with _state.lock:
+        evs = list(_state.events)
+    return evs[-last:] if last else evs
+
+
+def recent_events_payload(last: int = 16) -> list:
+    """JSON-safe tail of the compile ring — what a flight dump attaches
+    so OOM/wedge forensics show the compile storm beside the steps."""
+    return [e.as_dict() for e in events(last=last)]
+
+
+def reset() -> None:
+    """Clear counters/events (tests; the install state is untouched)."""
+    with _state.lock:
+        _state.events.clear()
+        _state.seq = 0
+        _state.total = 0
+        _state.post_warmup = 0
+        _state.warm = False
+        _state.by_fn.clear()
+        _state.pending_explanation = None
+
+
+@contextlib.contextmanager
+def expect_no_compiles(where: str = ""):
+    """Assert a region compiles NOTHING — the steady-state invariant that
+    replaces the old per-function ``_cache_size() == 1`` pins: stronger
+    (process-wide, any function) and self-describing on failure."""
+    if not installed():
+        install()
+    before = compile_count()
+    yield
+    after = compile_count()
+    if after != before:
+        fresh = events(last=after - before)
+        detail = "; ".join(
+            f"{e.fn} ({e.elapsed_ms:.1f}ms)"
+            + (f" — {e.explanation.splitlines()[0]}" if e.explanation else "")
+            for e in fresh
+        )
+        raise AssertionError(
+            f"{after - before} unexpected compile(s)"
+            + (f" in {where}" if where else "") + f": {detail}"
+        )

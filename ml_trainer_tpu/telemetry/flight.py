@@ -48,7 +48,28 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._seq = 0
+        self._context_providers: dict = {}
         self.last_dump_path: Optional[str] = None
+
+    def register_context_provider(self, name: str, fn) -> None:
+        """Attach ``fn()``'s JSON-safe payload to every future dump under
+        ``context[name]`` — how the memory ledger and compile watch ride
+        along on OOM/wedge forensics without the dump sites knowing them.
+        Idempotent by name (latest wins); a provider that raises at dump
+        time contributes its error string instead of killing the dump."""
+        with self._lock:
+            self._context_providers[name] = fn
+
+    def _collect_context(self) -> dict:
+        with self._lock:
+            providers = dict(self._context_providers)
+        out = {}
+        for name, fn in providers.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # forensics must never kill the dump
+                out[name] = f"context provider failed: {e}"
+        return out
 
     def record(self, kind: str, **data) -> None:
         """Append one record (thread-safe, O(1), never raises on data —
@@ -88,6 +109,9 @@ class FlightRecorder:
             **extra,
             "records": self.records(),
         }
+        context = self._collect_context()
+        if context:
+            payload["context"] = context
         d = self._resolve_dir(out_dir)
         path = os.path.join(
             d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}.json"

@@ -64,6 +64,14 @@ PEAK_HBM_BYTES = {
     "v5e": 819e9, "v5 lite": 819e9, "v5lite": 819e9,
     "v4": 1228e9,
 }
+# HBM *capacity* per chip (bytes) — the denominator of the fit-or-OOM
+# planner (telemetry/memory.py), next to the bandwidth table above.
+HBM_CAPACITY_BYTES = {
+    "v6e": 32 * 2 ** 30, "v6": 32 * 2 ** 30,
+    "v5p": 95 * 2 ** 30,
+    "v5e": 16 * 2 ** 30, "v5 lite": 16 * 2 ** 30, "v5lite": 16 * 2 ** 30,
+    "v4": 32 * 2 ** 30,
+}
 _FALLBACK_GEN = "v5e"
 
 
@@ -100,6 +108,12 @@ def chip_peak_flops(dtype: str = "bf16") -> float:
 def chip_peak_hbm_bytes() -> float:
     """Peak HBM bytes/s of one local chip (v5e fallback)."""
     return PEAK_HBM_BYTES[_match_generation() or _FALLBACK_GEN]
+
+
+def chip_hbm_capacity_bytes() -> float:
+    """HBM capacity in bytes of one local chip (v5e fallback) — what an
+    analytic memory ledger's peak prediction is judged against."""
+    return float(HBM_CAPACITY_BYTES[_match_generation() or _FALLBACK_GEN])
 
 
 def chip_generation_label() -> str:

@@ -1,0 +1,181 @@
+"""Goodput accounting: where the wall-clock of a training run went.
+
+MFU says how fast the chip runs while it runs; it says nothing about
+the minutes the chip sat idle behind a cold input pipeline, a blocking
+checkpoint enqueue, a compile storm, or a preemption gap.  Production
+trainers (TorchTitan, arXiv 2410.06511) treat that decomposition as a
+first-class metric: **goodput** = the fraction of wall-clock spent in
+productive training compute.
+
+This module is the process-wide ledger.  Layers account host seconds
+into named buckets (cheap locked adds, same idiom as the data-loader
+wait accounting in ``data/loader.py``):
+
+===================  ====================================================
+bucket               accounted by
+===================  ====================================================
+``data_wait``        host blocked assembling the next batch
+                     (``loader_wait_snapshot`` — existing accounting)
+``h2d``              host blocked placing batches on device
+                     (``prefetch_to_device``)
+``ckpt_stall``       host blocked in checkpoint enqueue / commit barriers
+                     (trainer ``ckpt_write`` sites, ``wait_for_checkpoints``)
+``compile``          XLA backend compiles (``compile_watch``)
+``rollback``         rollback-to-last-good restores (NaN escape hatch)
+``preempt_gap``      downtime between a preemption exit and the resume
+                     that consumed its marker (``PREEMPTED.json`` age)
+===================  ====================================================
+
+Everything not in a bucket is **compute** — the remainder against the
+run's wall-clock, so the buckets + compute sum to the wall-clock by
+construction (the bucket-arithmetic test pins the tolerance).  A
+:class:`GoodputMeter` anchors one run's window: the trainer starts it
+at ``fit()`` entry, reports at every telemetry sync
+(``train_goodput_fraction`` + ``train_goodput_seconds_total{bucket=}``
+gauges, a ``goodput_fraction`` heartbeat field for the cluster view),
+and distills the final decomposition into ``run_report.json``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Dict, Optional
+
+BUCKETS = (
+    "data_wait", "h2d", "ckpt_stall", "compile", "rollback", "preempt_gap",
+)
+
+_lock = threading.Lock()
+_acc: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+
+
+def account(bucket: str, secs: float) -> None:
+    """Add ``secs`` of non-compute wall-clock to ``bucket``."""
+    if bucket not in _acc:
+        raise ValueError(
+            f"unknown goodput bucket {bucket!r}; expected one of {BUCKETS}"
+        )
+    if secs <= 0:
+        return
+    with _lock:
+        _acc[bucket] += float(secs)
+
+
+@contextlib.contextmanager
+def timed(bucket: str):
+    """Account a host region's duration into ``bucket``."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        account(bucket, time.perf_counter() - t0)
+
+
+def snapshot() -> Dict[str, float]:
+    """Cumulative process-wide seconds per bucket."""
+    with _lock:
+        return dict(_acc)
+
+
+def reset() -> None:
+    """Zero the accumulators (tests only)."""
+    with _lock:
+        for b in BUCKETS:
+            _acc[b] = 0.0
+
+
+def decompose(wall_secs: float, base: Optional[Dict[str, float]] = None,
+              now: Optional[Dict[str, float]] = None) -> dict:
+    """Split ``wall_secs`` into buckets + the compute remainder.
+
+    ``base``/``now`` are :func:`snapshot` dicts bounding the window
+    (defaults: zero baseline / the current snapshot).  Bucket time can
+    legitimately exceed the wall-clock only through accounting overlap
+    (two buckets covering the same instant) — compute clamps at 0 and
+    the report records the overshoot instead of hiding it."""
+    now = now if now is not None else snapshot()
+    base = base or {}
+    wall = max(float(wall_secs), 0.0)
+    buckets = {
+        b: max(now.get(b, 0.0) - base.get(b, 0.0), 0.0) for b in BUCKETS
+    }
+    non_compute = sum(buckets.values())
+    compute = max(wall - non_compute, 0.0)
+    fraction = compute / wall if wall > 0 else 0.0
+    return {
+        "wall_secs": wall,
+        "compute_secs": compute,
+        "goodput_fraction": fraction,
+        "buckets_secs": buckets,
+        # > 0 only when bucket accounting overlapped the wall window
+        # (e.g. a compile observed on another thread) — visible, not
+        # silently clamped away.
+        "overshoot_secs": max(non_compute - wall, 0.0),
+    }
+
+
+class GoodputMeter:
+    """One run's goodput window over the process-wide ledger.
+
+    ``start()`` anchors the wall-clock and baselines the buckets;
+    ``report()`` publishes the cumulative decomposition since the anchor
+    (gauges + returns the dict); ``finish()`` reports one last time and
+    returns the final decomposition for the run report."""
+
+    def __init__(self, registry=None):
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        self.registry = registry if registry is not None else default_registry()
+        r = self.registry
+        self.g_fraction = r.gauge(
+            "train_goodput_fraction",
+            "fraction of wall-clock spent in productive train compute "
+            "(1 - data_wait/h2d/ckpt_stall/compile/rollback/preempt_gap)",
+        )
+        self.g_bucket = r.gauge(
+            "train_goodput_seconds_total",
+            "wall-clock seconds attributed to each non-compute bucket "
+            "since fit() start",
+            ("bucket",),
+        )
+        self.g_compute = r.gauge(
+            "train_goodput_compute_seconds_total",
+            "wall-clock seconds of productive compute since fit() start",
+        )
+        self._t0: Optional[float] = None
+        self._base: Dict[str, float] = {}
+        self.last: Optional[dict] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+        self._base = snapshot()
+        self.last = None
+
+    @property
+    def started(self) -> bool:
+        return self._t0 is not None
+
+    def report(self) -> Optional[dict]:
+        """Publish + return the decomposition since ``start()`` (None if
+        never started)."""
+        if self._t0 is None:
+            return None
+        d = decompose(time.perf_counter() - self._t0, base=self._base)
+        self.g_fraction.set(d["goodput_fraction"])
+        self.g_compute.set(d["compute_secs"])
+        for b, v in d["buckets_secs"].items():
+            self.g_bucket.labels(bucket=b).set(v)
+        self.last = d
+        return d
+
+    def finish(self) -> Optional[dict]:
+        return self.report()
+
+    def fraction(self) -> float:
+        """Current goodput fraction without publishing (heartbeats)."""
+        if self._t0 is None:
+            return 0.0
+        d = decompose(time.perf_counter() - self._t0, base=self._base)
+        return d["goodput_fraction"]

@@ -184,6 +184,12 @@ class TrainTelemetry:
             "train_loss_scale",
             "current dynamic loss scale (mixed precision; 0 = scaling off)",
         )
+        # Goodput ledger (telemetry/goodput.py): the trainer starts the
+        # meter at fit() entry; every sync republishes the wall-clock
+        # decomposition and the goodput fraction rides the heartbeat.
+        from ml_trainer_tpu.telemetry.goodput import GoodputMeter
+
+        self.goodput = GoodputMeter(registry=r)
         # The per-schedule train_pipeline_bubble_fraction{schedule=}
         # gauge is owned by parallel/pipeline.py (set at trace time, the
         # comm_stats discipline); on_sync only folds the active
@@ -245,6 +251,9 @@ class TrainTelemetry:
             self.g_comm_ratio.set(comm_ratio)
         skipped_d = skipped_total - self._last_skipped
         self._last_skipped = skipped_total
+        # Goodput: cumulative wall-clock decomposition since fit() start
+        # (gauges + the fraction for the event/heartbeat below).
+        gp = self.goodput.report() if self.goodput.started else None
         self.g_loss.set(host["loss_raw"])
         self.g_grad.set(host["grad_norm"])
         self.g_param.set(host["param_norm"])
@@ -276,6 +285,8 @@ class TrainTelemetry:
             event["step_ms_p50"] = round(self.step_ms_p50(), 3)
             event["step_ms_p99"] = round(self.step_ms_p99(), 3)
         event["loader_wait_ms"] = round(self.last_loader_wait_ms, 3)
+        if gp is not None:
+            event["goodput_fraction"] = round(gp["goodput_fraction"], 4)
         if loss_scale is not None:
             event["loss_scale"] = float(loss_scale)
         if self.overlap_fraction is not None:
@@ -325,6 +336,9 @@ class TrainTelemetry:
                 samples_per_sec=self.last_sps,
                 skipped_steps_total=skipped_total,
                 comm_bytes_total=comm_b,
+                goodput_fraction=(
+                    gp["goodput_fraction"] if gp is not None else 0.0
+                ),
             )
         return host
 

@@ -326,7 +326,18 @@ class Trainer:
         ``train_step_telemetry`` events, registry gauges
         (``telemetry.default_registry()``), and flight-recorder step
         records — plus samples/s, tokens/s and an analytic MFU estimate
-        (``telemetry/flops.py``, TPU backend only).
+        (``telemetry/flops.py``, TPU backend only).  Also arms the
+        third observability pillar: the analytic per-device HBM ledger
+        published as ``mem_*`` gauges with a live cross-check
+        (``telemetry/memory.py``), goodput accounting — per-run
+        wall-clock decomposed into data-wait / h2d / ckpt-stall /
+        compile / rollback / preempt-gap buckets behind a
+        ``train_goodput_fraction`` gauge (``telemetry/goodput.py``) —
+        and recompile forensics (``telemetry/compile_watch.py``:
+        ``compile_events_total{fn=}``, flight ``recompile`` events
+        naming the offending shape after the first epoch closes
+        warmup); flight dumps attach the device-memory snapshot and
+        recent compile events.
 
         ``log_every_steps``: override the host-sync cadence (default 50
         steps) — the progress-bar fetch, rollback check, and telemetry
@@ -622,7 +633,21 @@ class Trainer:
         self._flight = get_recorder()
         self._telemetry: Optional[Any] = None  # built with the loaders
         self._cluster: Optional[Any] = None  # built with the telemetry
+        self._memory_ledger: Optional[Any] = None  # built with the state
         self._profiler = StepProfiler("train")
+        if self.telemetry:
+            # Recompile forensics (telemetry/compile_watch.py): installed
+            # BEFORE the first model-init compile so the ledger covers
+            # every program this trainer builds.  Pure host bookkeeping —
+            # the compiled programs and trajectory are untouched
+            # (test-pinned).
+            from ml_trainer_tpu.telemetry import compile_watch
+
+            compile_watch.install()
+            # A new trainer legitimately compiles (init, train/eval
+            # steps): re-open warmup so a previous run's warm flag does
+            # not mis-flag this construction as recompile incidents.
+            compile_watch.mark_cold()
         # Per-step profiler polling only when something can trigger it.
         self._profile_hook = bool(
             self.telemetry
@@ -1089,6 +1114,11 @@ class Trainer:
                 f"(bucket_mb={self.bucket_mb}, analytic overlap fraction "
                 f"{self._bucket_plan.overlap_fraction:.2f})."
             )
+        # Batch geometry for the telemetry spine AND the memory ledger
+        # (set regardless of the telemetry flag so an on-demand
+        # memory.train_ledger(trainer) can always price the batch).
+        self._batch_geometry = (self.global_batch,) + tuple(sample_x.shape[1:])
+        self._batch_dtype = sample_x.dtype
         if self.telemetry:
             from ml_trainer_tpu.telemetry.cluster import ClusterTelemetry
             from ml_trainer_tpu.telemetry.train_metrics import TrainTelemetry
@@ -1104,13 +1134,50 @@ class Trainer:
                 model=self.model,
                 model_name=type(self.model).__name__,
                 global_batch=self.global_batch,
-                batch_shape=(self.global_batch,) + tuple(sample_x.shape[1:]),
+                batch_shape=self._batch_geometry,
                 flight=self._flight,
                 cluster=self._cluster,
                 compute_dtype=self.precision.label(),
                 overlap_fraction=(
                     self._bucket_plan.overlap_fraction
                     if self._bucket_plan is not None else None
+                ),
+            )
+            # HBM ledger (telemetry/memory.py): a metadata-only walk of
+            # the state just placed — published once here (the analytic
+            # components never change during the run) and attached to
+            # every flight dump, with the live per-device view, so OOM
+            # forensics name the resident components.
+            from ml_trainer_tpu.telemetry import (
+                compile_watch,
+                memory as _memory,
+            )
+
+            self._memory_ledger = _memory.train_ledger(self)
+            self._memory_ledger.publish()
+            self._flight.record(
+                "memory_ledger",
+                resident_bytes=int(self._memory_ledger.resident_bytes()),
+                peak_bytes=int(self._memory_ledger.peak_bytes()),
+                components={
+                    c.name: int(c.bytes)
+                    for c in self._memory_ledger.components
+                },
+            )
+            self._flight.register_context_provider(
+                "memory", _memory.memory_snapshot_payload
+            )
+            self._flight.register_context_provider(
+                "compile_events",
+                lambda: compile_watch.recent_events_payload(16),
+            )
+            logger.info(
+                "memory_ledger",
+                resident_mb=round(
+                    self._memory_ledger.resident_bytes() / 2 ** 20, 2
+                ),
+                peak_mb=round(
+                    self._memory_ledger.peak_bytes() / 2 ** 20, 2
                 ),
             )
         train_step = (
@@ -2073,6 +2140,13 @@ class Trainer:
             raise
         finally:
             self._restore_preempt_handlers(prev_handlers)
+            if self.telemetry:
+                # The recompile invariant is a property of THIS run's
+                # steady state; whatever compiles after fit() returns
+                # (test(), predict(), another trainer) is legitimate.
+                from ml_trainer_tpu.telemetry import compile_watch
+
+                compile_watch.mark_cold()
 
     def _install_preempt_handlers(self):
         if not self.handle_preemption:
@@ -2113,8 +2187,19 @@ class Trainer:
         logger.info("Start training..")
         start_epoch = 1
         ckpt_dir = os.path.join(self.model_dir, "checkpoints")
+        if self.telemetry:
+            # Goodput window: anchored here so every bucket (and the
+            # compute remainder) is charged against THIS run's wall
+            # clock; compile warmup re-opens for the programs this fit
+            # legitimately builds (closed after the first epoch below).
+            from ml_trainer_tpu.telemetry import compile_watch
+
+            compile_watch.mark_cold()
+            if self._telemetry is not None:
+                self._telemetry.goodput.start()
         if resume:
             start_epoch = self._resume_from_latest(ckpt_dir)
+        first_epoch = True
         for epoch in range(start_epoch, self.epochs + 1):
             # Checked at loop entry so a resumed run that comes back
             # already out of patience stops BEFORE training (and
@@ -2140,6 +2225,16 @@ class Trainer:
             self.clear()
             self._validate_one_epoch()
             self.clear()
+            if first_epoch:
+                # Every program a steady-state epoch needs (train + eval,
+                # full and ragged-tail shapes) has now compiled: any
+                # compile from here on is a recompile incident the watch
+                # records with flight forensics.
+                first_epoch = False
+                if self.telemetry:
+                    from ml_trainer_tpu.telemetry import compile_watch
+
+                    compile_watch.mark_warm()
             if self._plateau is not None:
                 self._lr_scale = self._plateau.update(self.val_losses[-1])
             # Every host computes the same val loss, so `improved` (and the
@@ -2183,6 +2278,7 @@ class Trainer:
                 ckpt.fetch_to_host(variables)
                 if (is_primary() or export_is_collective) else None
             )
+            from ml_trainer_tpu.telemetry import goodput
             from ml_trainer_tpu.telemetry.spans import span
 
             if is_primary():
@@ -2191,7 +2287,8 @@ class Trainer:
 
                 # One device fetch + serialization covers both exports
                 # (the best/ copy is the same bytes on improving epochs).
-                with span("model_export", epoch=epoch):
+                with span("model_export", epoch=epoch), \
+                        goodput.timed("ckpt_stall"):
                     data = serialization.to_bytes(host_vars)
                     ckpt.write_model_bytes(self.model_dir, data)
                     if improved and self.save_best:
@@ -2201,7 +2298,8 @@ class Trainer:
             if self._sharded_ckpt:
                 # COLLECTIVE: every process contributes its addressable
                 # shards; no host gathers the full state (format v3).
-                with span("ckpt_write", epoch=epoch, sharded=True):
+                with span("ckpt_write", epoch=epoch, sharded=True), \
+                        goodput.timed("ckpt_stall"):
                     ckpt.save_checkpoint_sharded(
                         ckpt_dir, self.state, self._partial_history(), epoch,
                         block=False,
@@ -2211,7 +2309,8 @@ class Trainer:
                 # while the next epoch trains (jax arrays are immutable, so
                 # the snapshot is consistent); fit-end joins the queue.
                 # The span covers the enqueue (the host-blocking part).
-                with span("ckpt_write", epoch=epoch, sharded=False):
+                with span("ckpt_write", epoch=epoch, sharded=False), \
+                        goodput.timed("ckpt_stall"):
                     ckpt.save_checkpoint(
                         ckpt_dir, self.state, self._partial_history(), epoch,
                         block=False,
@@ -2245,7 +2344,10 @@ class Trainer:
         }
         if self.save_history and is_primary():
             self.save_history_(self.model_dir)
-        ckpt.wait_for_checkpoints()
+        from ml_trainer_tpu.telemetry import goodput
+
+        with goodput.timed("ckpt_stall"):
+            ckpt.wait_for_checkpoints()
         self._write_run_report("preempted" if self.preempted else "completed")
         logger.info("Training Complete.")
 
@@ -2335,12 +2437,13 @@ class Trainer:
             "metric_sum": float(metric_sum),
             "skipped_base": int(self._skipped_base),
         }
+        from ml_trainer_tpu.telemetry import goodput
         from ml_trainer_tpu.telemetry.spans import span
 
         ckpt_dir = os.path.join(self.model_dir, "checkpoints")
         if self._sharded_ckpt:
             with span("ckpt_write", epoch=epoch, batch=batches_done,
-                      sharded=True):
+                      sharded=True), goodput.timed("ckpt_stall"):
                 ckpt.save_checkpoint_sharded(
                     ckpt_dir, self.state, hist, epoch, block=False
                 )
@@ -2348,7 +2451,7 @@ class Trainer:
             # Async: the writer thread serializes this with epoch-end
             # saves (single-queue FIFO), so same-epoch writes never race.
             with span("ckpt_write", epoch=epoch, batch=batches_done,
-                      sharded=False):
+                      sharded=False), goodput.timed("ckpt_stall"):
                 ckpt.save_checkpoint(
                     ckpt_dir, self.state, hist, epoch, block=False
                 )
@@ -2383,7 +2486,14 @@ class Trainer:
             return
         try:
             from ml_trainer_tpu.telemetry.cluster import write_run_report
+            from ml_trainer_tpu.telemetry.memory import publish_live_memory
 
+            if self._telemetry is not None:
+                # Final goodput decomposition + the live per-device
+                # memory view, published so the report's sections read
+                # the end-of-run numbers, not the last sync's.
+                self._telemetry.goodput.finish()
+            publish_live_memory()
             write_run_report(
                 self.model_dir,
                 history=self.history or self._partial_history(),
@@ -2424,39 +2534,42 @@ class Trainer:
         )
         zero = jax.device_put(jnp.zeros((), jnp.int32), self._replicated)
         ckpt_dir = os.path.join(self.model_dir, "checkpoints")
-        ckpt.wait_for_checkpoints()  # in-flight async writes must land
-        latest = ckpt.latest_valid_checkpoint(
-            ckpt_dir, quarantine=is_primary()
-        )
-        if latest is None:
-            # The guard already reverted every bad update, so the live
-            # params ARE the last good ones; just clear the streak.
-            logger.warning(
-                f"Rollback: {streak} consecutive non-finite steps and no "
-                f"valid checkpoint; LR scale backed off to "
-                f"{self._lr_scale:.4g}, continuing from current params."
-            )
-            self.state = self.state.replace(bad_streak=zero)
-            return True
-        skipped_now = self.state.skipped_steps
-        if ckpt.checkpoint_format(latest) == 3:
-            state, _, _ = ckpt.restore_checkpoint(
-                latest, self.state, self._state_shardings
-            )
-            self.state = state
-        else:
-            state, _, _ = ckpt.restore_checkpoint(
-                latest, ckpt.fetch_to_host(self.state)
-            )
-            from ml_trainer_tpu.parallel import place_tree
+        from ml_trainer_tpu.telemetry import goodput
 
-            self.state = place_tree(state, self._state_shardings)
-        # Keep the cumulative skipped count (diagnostics) but clear the
-        # streak — the restored counters predate the event.
-        self.state = self.state.replace(
-            bad_streak=zero, skipped_steps=skipped_now
-        )
-        self._reseed_loss_scale()
+        with goodput.timed("rollback"):
+            ckpt.wait_for_checkpoints()  # in-flight async writes must land
+            latest = ckpt.latest_valid_checkpoint(
+                ckpt_dir, quarantine=is_primary()
+            )
+            if latest is None:
+                # The guard already reverted every bad update, so the live
+                # params ARE the last good ones; just clear the streak.
+                logger.warning(
+                    f"Rollback: {streak} consecutive non-finite steps and "
+                    f"no valid checkpoint; LR scale backed off to "
+                    f"{self._lr_scale:.4g}, continuing from current params."
+                )
+                self.state = self.state.replace(bad_streak=zero)
+                return True
+            skipped_now = self.state.skipped_steps
+            if ckpt.checkpoint_format(latest) == 3:
+                state, _, _ = ckpt.restore_checkpoint(
+                    latest, self.state, self._state_shardings
+                )
+                self.state = state
+            else:
+                state, _, _ = ckpt.restore_checkpoint(
+                    latest, ckpt.fetch_to_host(self.state)
+                )
+                from ml_trainer_tpu.parallel import place_tree
+
+                self.state = place_tree(state, self._state_shardings)
+            # Keep the cumulative skipped count (diagnostics) but clear
+            # the streak — the restored counters predate the event.
+            self.state = self.state.replace(
+                bad_streak=zero, skipped_steps=skipped_now
+            )
+            self._reseed_loss_scale()
         logger.warning(
             f"Rollback: {streak} consecutive non-finite steps; restored "
             f"{latest} and backed LR off to scale {self._lr_scale:.4g}."
@@ -2494,6 +2607,16 @@ class Trainer:
             f"Clean preemption exit detected ({info}); resuming from the "
             "emergency checkpoint."
         )
+        if info.get("time"):
+            # Downtime attribution: the age of the marker is the gap the
+            # preemption cost between exit and this resume — the
+            # goodput ledger's preempt_gap bucket (clamped: clock skew
+            # must not mint negative downtime).
+            from ml_trainer_tpu.telemetry import goodput
+
+            goodput.account(
+                "preempt_gap", max(time.time() - float(info["time"]), 0.0)
+            )
         if is_primary():
             try:
                 os.remove(marker)

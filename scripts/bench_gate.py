@@ -449,6 +449,93 @@ def gate_pipeline(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_goodput_reference(repo: str = REPO):
+    """The committed memory/goodput artifact
+    (docs/memory_goodput_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "memory_goodput_cpu.json")
+    try:
+        return json.load(open(path))
+    except (OSError, ValueError):
+        return None
+
+
+def gate_goodput(threshold: float) -> dict:
+    """The memory-ledger / goodput / recompile gate (the third
+    observability pillar): re-runs ``scripts/memory_smoke.py`` in a
+    subprocess (it needs its own 2-virtual-device process) and enforces
+
+    1. **Invariants** (hard): the smoke itself passes — analytic
+       ledger within 10% of the measured per-device state bytes on the
+       pure-DP / ZeRO-1 / 2-stage-pipeline legs, goodput buckets
+       reconstruct the wall-clock, ZERO post-warmup compiles;
+    2. **Goodput floor**: ``train_goodput_fraction`` >= 0.02 (compiles
+       legitimately dominate a tiny CPU dryrun; the floor catches a
+       stall, not noise);
+    3. **Ledger trajectory** (machine-independent): the analytic bytes
+       per config match the committed artifact EXACTLY — the shapes are
+       deterministic, so any drift is a formula or state-layout change
+       that must arrive as a deliberate artifact update.
+    """
+    import subprocess
+
+    script = os.path.join(REPO, "scripts", "memory_smoke.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=280, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "decided_by": "worker",
+                "error": "memory_smoke.py timed out"}
+    line = next(
+        (ln for ln in proc.stdout.splitlines()
+         if ln.startswith("MEMORY_SMOKE_RESULT ")), None,
+    )
+    if proc.returncode != 0 or line is None:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-8:]
+        return {"ok": False, "decided_by": "invariants",
+                "error": "memory_smoke failed: " + " | ".join(tail)}
+    result = json.loads(line[len("MEMORY_SMOKE_RESULT "):])
+    out = {
+        "configs": result["configs"],
+        "goodput_fraction": result["goodput"]["fraction"],
+        "post_warmup_compiles": result["compiles"]["post_warmup"],
+        "threshold": threshold,
+    }
+    bad = [r for r in result["configs"] if not r["ok"]]
+    if bad or result["compiles"]["post_warmup"]:
+        out.update(ok=False, decided_by="invariants",
+                   error=f"smoke invariants violated: {bad or 'recompiles'}")
+        return out
+    if result["goodput"]["fraction"] < 0.02:
+        out.update(ok=False, decided_by="goodput_floor",
+                   error=f"goodput fraction "
+                         f"{result['goodput']['fraction']} < 0.02")
+        return out
+    committed = committed_goodput_reference()
+    if committed is not None:
+        ref = {r["config"]: r for r in committed.get("configs", [])}
+        for row in result["configs"]:
+            want = ref.get(row["config"], {}).get("analytic_bytes")
+            if want is not None and int(want) != int(row["analytic_bytes"]):
+                out.update(
+                    ok=False, decided_by="ledger_trajectory",
+                    error=(
+                        f"{row['config']}: analytic ledger "
+                        f"{row['analytic_bytes']} != committed {want} — "
+                        "formula/state-layout drift; update "
+                        "docs/memory_goodput_cpu.json deliberately"
+                    ),
+                )
+                return out
+        out["decided_by"] = "trajectory"
+    else:
+        out["decided_by"] = "invariants"
+        out["note"] = "no committed artifact; invariants only"
+    out["ok"] = True
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--threshold", type=float, default=float(
@@ -466,6 +553,9 @@ def main() -> int:
                         "gate")
     parser.add_argument("--skip-pipeline", action="store_true",
                         help="skip the pipeline-schedule gate")
+    parser.add_argument("--skip-goodput", action="store_true",
+                        help="skip the memory-ledger / goodput / "
+                        "recompile gate")
     args = parser.parse_args()
 
     import jax
@@ -546,6 +636,19 @@ def main() -> int:
             f"BENCH_GATE PIPELINE OK ({pipe['decided_by']}): 1f1b at "
             f"{pipe['gpipe_over_1f1b_s4_m8']}x gpipe step rate "
             f"(S=4/M=8), {pipe.get('f1b_steps_per_sec')} steps/s",
+            flush=True,
+        )
+    if not args.skip_goodput:
+        gp = gate_goodput(args.threshold)
+        print(json.dumps({"bench_gate_goodput": gp}), flush=True)
+        if not gp["ok"]:
+            print(f"BENCH_GATE GOODPUT FAIL: {gp.get('error')}", flush=True)
+            return 1
+        print(
+            f"BENCH_GATE GOODPUT OK ({gp['decided_by']}): "
+            f"{len(gp['configs'])} ledger configs agree, goodput "
+            f"{gp['goodput_fraction']}, "
+            f"{gp['post_warmup_compiles']} post-warmup compiles",
             flush=True,
         )
     return 0

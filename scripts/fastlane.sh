@@ -14,7 +14,11 @@
 # overflow-backoff semantics), a pipeline-schedule smoke leg
 # (scripts/pipeline_smoke.py: 1F1B + interleaved through the real
 # Trainer on a 2-virtual-device stage mesh, serial-fold trajectory
-# equality, zero recompiles, per-hop comm + bubble gauges), and a bench
+# equality, zero recompiles, per-hop comm + bubble gauges), a memory /
+# goodput / recompile smoke leg (scripts/memory_smoke.py: analytic HBM
+# ledger within 10% of measured state bytes on pure-DP / ZeRO-1 /
+# pipeline configs, goodput bucket arithmetic, zero post-warmup
+# compiles), and a bench
 # regression gate (scripts/bench_gate.py) that fails on >10% samples/s
 # regression vs the committed BENCH trajectory / this machine's
 # calibrated baseline — plus the paged-serving replay gate (byte
@@ -56,8 +60,12 @@ echo "# pipeline-schedule smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/pipeline_smoke.py
 pipeline_rc=$?
 [ $pipeline_rc -ne 0 ] && echo "# pipeline smoke FAILED (rc=$pipeline_rc)"
+echo "# memory ledger / goodput / recompile smoke leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py
+memory_rc=$?
+[ $memory_rc -ne 0 ] && echo "# memory smoke FAILED (rc=$memory_rc)"
 echo "# bench regression gate"
-timeout -k 10 900 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -66,5 +74,6 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$paged_rc
 [ $rc -eq 0 ] && rc=$mixed_rc
 [ $rc -eq 0 ] && rc=$pipeline_rc
+[ $rc -eq 0 ] && rc=$memory_rc
 [ $rc -eq 0 ] && rc=$gate_rc
 exit $rc

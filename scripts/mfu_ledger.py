@@ -118,12 +118,17 @@ def measure(model_name: str, batch: int) -> dict:
     peak_flops, peak_bw, hbm_generation = chip_peaks()
     achieved_flops = flops / dt if flops else None
     achieved_bw = bytes_accessed / dt if bytes_accessed else None
-    # Analytic cross-check (telemetry/flops.py): when the measured XLA
-    # number and the formula disagree wildly, one of them is lying about
-    # the workload — worth seeing in the artifact.
+    # Analytic cross-checks (telemetry/flops.py + memory.py): when the
+    # measured XLA number and the formula disagree wildly, one of them
+    # is lying about the workload — worth seeing in the artifact.  The
+    # memory column puts the ledger's peak prediction beside the chip
+    # allocator's real peak, per batch size.
+    from ml_trainer_tpu.telemetry import memory as _memory
     from ml_trainer_tpu.telemetry.flops import train_step_flops
 
     analytic = train_step_flops(model, (batch, 224, 224, 3))
+    mem_live = _memory.live_memory_snapshot()
+    mem_ledger = _memory.bench_step_ledger(state, model, (x, y))
     row = {
         "model": model_name,
         "batch": batch,
@@ -136,6 +141,9 @@ def measure(model_name: str, batch: int) -> dict:
         "arith_intensity_flops_per_byte": (
             round(flops / bytes_accessed, 1) if bytes_accessed else None
         ),
+        "peak_hbm_bytes": int(mem_live["max_peak_bytes_in_use"]),
+        "analytic_hbm_bytes": int(mem_ledger.peak_bytes()),
+        "analytic_hbm_resident_bytes": int(mem_ledger.resident_bytes()),
         "mfu": round(achieved_flops / peak_flops, 4) if achieved_flops else None,
         "hbm_utilization": (
             round(achieved_bw / peak_bw, 4) if achieved_bw else None

@@ -101,8 +101,13 @@ def main() -> int:
             t_serial_ref.train_losses, t_pp.train_losses, rtol=1e-3,
             err_msg=f"{sched} trajectory diverged from the serial fold",
         )
-        assert t_pp._train_step._cache_size() == 1, (
-            f"{sched} train step recompiled"
+        # The real recompile instrument (telemetry/compile_watch.py):
+        # nothing compiled after each fit's first epoch closed warmup.
+        from ml_trainer_tpu.telemetry import compile_watch
+
+        assert compile_watch.post_warmup_count() == 0, (
+            f"{sched} train step recompiled: "
+            f"{[e.as_dict() for e in compile_watch.events(last=4)]}"
         )
         hops = comm_hop_bytes().get(sched, {})
         assert "fwd" in hops and "bwd" in hops and (

@@ -78,16 +78,30 @@ def main() -> int:
     snap = default_registry().snapshot()
     if snap.get("train_skipped_steps_total", 0) < 1:
         return fail(f"skipped-step counter not published: {snap}")
-    if t._train_step._cache_size() != 1:
+    # The real recompile instrument (telemetry/compile_watch.py) replaces
+    # the old per-function _cache_size() pin: the train step compiled
+    # exactly once, and the labeled counter reached the registry.
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    if compile_watch.compile_count("jit(train_step)") != 1:
         return fail(
-            f"telemetry caused recompiles: {t._train_step._cache_size()}"
+            f"telemetry caused recompiles: {compile_watch.counts_by_fn()}"
         )
+    if snap.get("compile_events_total{fn=jit(train_step)}") != 1:
+        return fail("compile_events_total{fn=} counter not published")
     dumps = [f for f in os.listdir(workdir) if f.startswith("flight_")]
     if not dumps:
         return fail("no flight dump after nan_grad rollback")
     payload = json.load(open(os.path.join(workdir, dumps[0])))
     if payload.get("first_bad_step") != 3:
         return fail(f"flight dump does not name step 3: {payload.get('first_bad_step')}")
+    # OOM/wedge forensics ride along: the dump attaches the device-memory
+    # snapshot and the recent compile events (flight context providers).
+    ctx = payload.get("context", {})
+    if "live" not in ctx.get("memory", {}):
+        return fail(f"flight dump missing memory snapshot: {list(ctx)}")
+    if not isinstance(ctx.get("compile_events"), list):
+        return fail(f"flight dump missing compile events: {list(ctx)}")
     hist = load_history(workdir)
     if hist.get("rollbacks") != 1 or sum(hist.get("skipped_steps", [])) != 1:
         return fail(f"history.json resilience ledger wrong: {hist}")

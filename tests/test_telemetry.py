@@ -239,12 +239,26 @@ def test_step_telemetry_zero_recompiles_and_identical_trajectory(tmp_path):
     """The acceptance pin: the instrumented train step compiles exactly
     as many programs as the bare one (one), across a full multi-epoch
     fit — and produces the bit-identical parameter trajectory."""
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    compile_watch.install()
+    before = compile_watch.compile_count("jit(train_step)")
+    pw_before = compile_watch.post_warmup_count()
     bare = make_trainer(tmp_path / "bare", epochs=2)
     bare.fit()
     instr = make_trainer(tmp_path / "instr", epochs=2, telemetry=True)
     instr.fit()
-    assert bare._train_step._cache_size() == 1
-    assert instr._train_step._cache_size() == 1
+    # The real recompile instrument (telemetry/compile_watch.py) replaces
+    # the per-function _cache_size() pin: each trainer compiled its train
+    # step exactly once across the 2-epoch fit, and nothing compiled
+    # after the instrumented run's first epoch closed warmup (deltas —
+    # the counters are process-cumulative).
+    assert compile_watch.compile_count("jit(train_step)") == before + 2, (
+        compile_watch.counts_by_fn()
+    )
+    assert compile_watch.post_warmup_count() == pw_before, (
+        [e.as_dict() for e in compile_watch.events(last=4)]
+    )
     for a, b in zip(
         jax.tree.leaves(bare.state.params),
         jax.tree.leaves(instr.state.params),
@@ -260,12 +274,18 @@ def test_step_telemetry_zero_recompiles_and_identical_trajectory(tmp_path):
 def test_multi_step_dispatch_carries_stats(tmp_path):
     """steps_per_execution > 1: the scanned dispatch returns the last
     step's stats and telemetry still compiles one multi-step program."""
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    compile_watch.install()
+    before = compile_watch.compile_count("jit(multi_step)")
     t = make_trainer(
         tmp_path / "multi", size=128, telemetry=True,
         steps_per_execution=4,
     )
     t.fit()
-    assert t._train_multi_step._cache_size() == 1
+    assert compile_watch.compile_count("jit(multi_step)") == before + 1, (
+        compile_watch.counts_by_fn()
+    )
     from ml_trainer_tpu.telemetry import default_registry
 
     assert default_registry().snapshot()["train_param_norm"] > 0
