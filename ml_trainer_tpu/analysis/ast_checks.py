@@ -1,0 +1,833 @@
+"""AST lint pack — the host-side concurrency front end of graft-lint.
+
+The serving stack is explicitly threaded (HTTP handlers submit, the
+engine loop admits/steps, the watchdog and exporters read), and its
+safety rests on conventions the type system cannot see: which attributes
+a lock guards, in what order locks nest, which modules must never touch
+a device, and which loops must never sync the host.  This module checks
+those conventions statically over the real source tree:
+
+* **lock-order graph + cycle detection** (``lock-order-cycle``) — every
+  ``with <lock>`` region is walked; a call made while holding lock A to
+  code that (transitively) acquires lock B adds the edge A→B.  A cycle
+  in that graph is a potential deadlock the moment two threads interleave
+  — including the length-1 cycle of re-acquiring a non-reentrant
+  ``threading.Lock`` already held.
+* **unguarded shared state** (``unguarded-shared-state``) — in a class
+  that owns a lock, an attribute assigned under the lock anywhere is
+  *guarded*; assigning it outside a lock region (in any method except
+  ``__init__``, and except private helpers only ever called from
+  lock-held regions — the ``# Caller holds the lock`` idiom, which is
+  also honored as a comment) is a race.
+* **device ops in host-only modules** (``device-op-in-host-module``) —
+  the scheduler, page pool, and prefix cache are host-side data
+  structures on the serving hot path; importing ``jax`` there invites
+  silent dispatches into admission control.
+* **host-sync in hot loops** (``host-sync-hot-loop``) — in the
+  registered hot functions (the engine step loops, the trainer epoch
+  loops), every ``.item()``, ``jax.device_get``, single-argument
+  ``np.asarray``/``np.array``, and ``float()`` coercion is flagged
+  unless annotated: ``# graft-lint: sync-ok`` marks an *intentional*
+  fence (the one sync the loop is designed around), ``# graft-lint:
+  host-value`` marks a provably host-side value.  New syncs in a hot
+  loop therefore fail the gate until someone writes down why.
+* **import hygiene** (``unused-import``) — the F401 subset of the ruff
+  configuration in pyproject.toml, implemented in-tree so the gate
+  enforces it even where ruff is not installed (this container bakes
+  the jax toolchain, not ruff).  ``__init__.py`` re-export surfaces are
+  exempt; ``# noqa`` is honored.
+
+Suppression syntax (all rules): ``# graft-lint: disable=<rule>[,<rule>]``
+on the offending line, or alone on the line above.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ml_trainer_tpu.analysis.findings import Finding
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graft-lint:\s*(disable=(?P<rules>[\w,.-]+)|(?P<alias>sync-ok|host-value))"
+)
+_NOQA_RE = re.compile(r"#\s*noqa\b", re.IGNORECASE)
+_CALLER_HOLDS_RE = re.compile(r"#\s*caller holds the lock", re.IGNORECASE)
+
+# Known factory functions -> the class their return value behaves as
+# (for resolving ``self.x = get_recorder(); ... self.x.record()``).
+FACTORY_TYPES = {
+    "get_recorder": "FlightRecorder",
+    "default_registry": "MetricsRegistry",
+    "default_sink": "JsonlSink",
+}
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """What the AST pack checks where.  Paths are repo-relative and
+    matched by suffix so the pack works from any checkout root."""
+
+    # (path suffix, qualified function name) pairs whose bodies are
+    # treated as device-dispatch hot loops.
+    hot_functions: Tuple[Tuple[str, str], ...] = (
+        ("serving/engine.py", "SlotDecodeEngine.step"),
+        ("serving/engine.py", "SlotDecodeEngine._step_spec"),
+        ("trainer.py", "Trainer._train_one_epoch"),
+        ("trainer.py", "Trainer._train_one_epoch_multi"),
+    )
+    # Host-side data-structure modules that must never import jax.
+    host_only_modules: Tuple[str, ...] = (
+        "serving/scheduler.py",
+        "serving/kv_pool.py",
+        "serving/prefix_cache.py",
+    )
+    # Modules exempt from the unused-import rule (re-export surfaces).
+    import_exempt: Tuple[str, ...] = ("__init__.py",)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    relpath: str
+    source: str
+    tree: ast.Module
+    # lineno -> suppressed rule names ('*' for the bare aliases).
+    suppressions: Dict[int, Set[str]]
+    lock_held_comment_lines: Set[int]
+
+
+def _parse_suppressions(source: str):
+    sup: Dict[int, Set[str]] = {}
+    holds: Set[int] = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            if m.group("alias"):
+                rules = {"host-sync-hot-loop"}
+            else:
+                rules = {r.strip() for r in m.group("rules").split(",")}
+            target = sup.setdefault(i, set())
+            target |= rules
+            if line.strip().startswith("#"):
+                # Standalone comment: applies to the next line too.
+                sup.setdefault(i + 1, set()).update(rules)
+        if _NOQA_RE.search(line):
+            sup.setdefault(i, set()).add("unused-import")
+        if _CALLER_HOLDS_RE.search(line):
+            holds.add(i)
+    return sup, holds
+
+
+def load_module(relpath: str, source: str) -> Optional[ModuleInfo]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    sup, holds = _parse_suppressions(source)
+    return ModuleInfo(relpath, source, tree, sup, holds)
+
+
+def scan_tree(root: str,
+              subdirs: Sequence[str] = ("ml_trainer_tpu", "scripts"),
+              ) -> Dict[str, ModuleInfo]:
+    """Parse every ``.py`` under ``root``'s configured subdirs into
+    ModuleInfos keyed by repo-relative path."""
+    modules: Dict[str, ModuleInfo] = {}
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        for dirpath, _, files in os.walk(base):
+            if "__pycache__" in dirpath:
+                continue
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, root)
+                try:
+                    with open(path, encoding="utf-8") as fp:
+                        src = fp.read()
+                except OSError:
+                    continue
+                info = load_module(rel, src)
+                if info is not None:
+                    modules[rel] = info
+    return modules
+
+
+def modules_from_sources(sources: Dict[str, str]) -> Dict[str, ModuleInfo]:
+    """Test hook: build the module map from in-memory sources."""
+    out = {}
+    for rel, src in sources.items():
+        info = load_module(rel, src)
+        if info is not None:
+            out[rel] = info
+    return out
+
+
+def _suppressed(info: ModuleInfo, lineno: int, rule: str) -> bool:
+    rules = info.suppressions.get(lineno, ())
+    return rule in rules or "*" in rules
+
+
+# ---------------------------------------------------------------- lock IR
+@dataclasses.dataclass
+class _ClassIR:
+    name: str
+    module: str
+    lock_attrs: Dict[str, str]          # attr -> "Lock" | "RLock"
+    attr_types: Dict[str, str]          # self.attr -> class name
+    methods: Dict[str, ast.FunctionDef]
+
+
+@dataclasses.dataclass
+class _LockIR:
+    """Cross-module index the concurrency rules share."""
+
+    classes: Dict[str, _ClassIR]                 # class name -> IR
+    module_locks: Dict[str, Dict[str, str]]      # relpath -> name -> kind
+    module_funcs: Dict[str, Dict[str, ast.FunctionDef]]
+
+
+def _lock_kind(node: ast.expr) -> Optional[str]:
+    """'Lock'/'RLock' when ``node`` is a ``threading.[R]Lock()`` call."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    return name if name in ("Lock", "RLock") else None
+
+
+def _called_class(node: ast.expr,
+                  known_classes: Set[str]) -> Optional[str]:
+    """Class name a constructor-ish call resolves to, if known."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else (
+        fn.id if isinstance(fn, ast.Name) else None
+    )
+    if name is None:
+        return None
+    if name in known_classes:
+        return name
+    return FACTORY_TYPES.get(name)
+
+
+def _build_lock_ir(modules: Dict[str, ModuleInfo]) -> _LockIR:
+    classes: Dict[str, _ClassIR] = {}
+    module_locks: Dict[str, Dict[str, str]] = {}
+    module_funcs: Dict[str, Dict[str, ast.FunctionDef]] = {}
+    known_classes: Set[str] = set()
+    for info in modules.values():
+        for node in info.tree.body:
+            if isinstance(node, ast.ClassDef):
+                known_classes.add(node.name)
+    for rel, info in modules.items():
+        module_locks[rel] = {}
+        module_funcs[rel] = {}
+        for node in info.tree.body:
+            if isinstance(node, ast.Assign):
+                kind = _lock_kind(node.value)
+                if kind:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            module_locks[rel][t.id] = kind
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                module_funcs[rel][node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                ir = _ClassIR(node.name, rel, {}, {}, {})
+                for item in node.body:
+                    if isinstance(item,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        ir.methods[item.name] = item
+                        # Annotated params type cross-object references
+                        # (``def __init__(self, engine: "Engine")``).
+                        param_types = {}
+                        for arg in item.args.args:
+                            ann = arg.annotation
+                            name = None
+                            if isinstance(ann, ast.Name):
+                                name = ann.id
+                            elif (isinstance(ann, ast.Constant)
+                                  and isinstance(ann.value, str)):
+                                name = ann.value.strip("'\"")
+                            if name in known_classes:
+                                param_types[arg.arg] = name
+                        for sub in ast.walk(item):
+                            if not isinstance(sub, ast.Assign):
+                                continue
+                            for t in sub.targets:
+                                if (isinstance(t, ast.Attribute)
+                                        and isinstance(t.value, ast.Name)
+                                        and t.value.id == "self"):
+                                    kind = _lock_kind(sub.value)
+                                    if kind:
+                                        ir.lock_attrs[t.attr] = kind
+                                    cls = _called_class(
+                                        sub.value, known_classes
+                                    )
+                                    if (cls is None
+                                            and isinstance(sub.value,
+                                                           ast.Name)):
+                                        cls = param_types.get(
+                                            sub.value.id
+                                        )
+                                    if cls:
+                                        ir.attr_types[t.attr] = cls
+                classes[node.name] = ir
+    return _LockIR(classes, module_locks, module_funcs)
+
+
+def _lock_id_of(expr: ast.expr, rel: str, cls: Optional[_ClassIR],
+                ir: _LockIR) -> Optional[Tuple[str, str]]:
+    """Resolve a ``with`` item to (lock id, kind), or None.
+
+    Forms: ``self._lock`` (class lock), ``name`` (module lock),
+    ``self.attr._lock`` (lock of a typed attribute's class)."""
+    if isinstance(expr, ast.Name):
+        kind = ir.module_locks.get(rel, {}).get(expr.id)
+        if kind:
+            return f"{os.path.basename(rel)}:{expr.id}", kind
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id == "self" and cls:
+            kind = cls.lock_attrs.get(expr.attr)
+            if kind:
+                return f"{cls.name}.{expr.attr}", kind
+            return None
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls):
+            target_cls = cls.attr_types.get(base.attr)
+            t_ir = ir.classes.get(target_cls) if target_cls else None
+            if t_ir:
+                kind = t_ir.lock_attrs.get(expr.attr)
+                if kind:
+                    return f"{t_ir.name}.{expr.attr}", kind
+    return None
+
+
+def _resolve_call(node: ast.Call, rel: str, cls: Optional[_ClassIR],
+                  ir: _LockIR) -> Optional[Tuple[str, str]]:
+    """(class name or '', method/function name) a call resolves to —
+    only for targets the IR knows about."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        if isinstance(base, ast.Name) and base.id == "self" and cls:
+            if fn.attr in cls.methods:
+                return cls.name, fn.attr
+        if (isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self" and cls):
+            target_cls = cls.attr_types.get(base.attr)
+            t_ir = ir.classes.get(target_cls) if target_cls else None
+            if t_ir and fn.attr in t_ir.methods:
+                return t_ir.name, fn.attr
+    elif isinstance(fn, ast.Name):
+        if fn.id in ir.module_funcs.get(rel, {}):
+            return "", f"{rel}:{fn.id}"
+    return None
+
+
+def _function_key(cls_name: str, fn_name: str) -> str:
+    return f"{cls_name}.{fn_name}" if cls_name else fn_name
+
+
+def _direct_acquires(fn: ast.AST, rel: str, cls: Optional[_ClassIR],
+                     ir: _LockIR) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                got = _lock_id_of(item.context_expr, rel, cls, ir)
+                if got:
+                    out.add(got[0])
+    return out
+
+
+def _callees(fn: ast.AST, rel: str, cls: Optional[_ClassIR],
+             ir: _LockIR) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            got = _resolve_call(node, rel, cls, ir)
+            if got:
+                out.add(_function_key(*got))
+    return out
+
+
+def _acquire_summaries(ir: _LockIR) -> Dict[str, Set[str]]:
+    """Fixpoint: every known function/method -> locks it may acquire,
+    directly or through calls the IR can resolve."""
+    fns: Dict[str, Tuple[ast.AST, str, Optional[_ClassIR]]] = {}
+    for cls in ir.classes.values():
+        for name, fn in cls.methods.items():
+            fns[_function_key(cls.name, name)] = (fn, cls.module, cls)
+    for rel, funcs in ir.module_funcs.items():
+        for name, fn in funcs.items():
+            fns[f"{rel}:{name}"] = (fn, rel, None)
+    acquires = {
+        key: _direct_acquires(fn, rel, cls, ir)
+        for key, (fn, rel, cls) in fns.items()
+    }
+    callee_map = {
+        key: _callees(fn, rel, cls, ir) & set(fns)
+        for key, (fn, rel, cls) in fns.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, callees in callee_map.items():
+            before = len(acquires[key])
+            for c in callees:
+                acquires[key] |= acquires[c]
+            if len(acquires[key]) != before:
+                changed = True
+    return acquires
+
+
+# ------------------------------------------------------- lock-order rule
+def check_lock_order(modules: Dict[str, ModuleInfo],
+                     config: Optional[LintConfig] = None) -> List[Finding]:
+    """Build the lock-order graph and report cycles (incl. self-cycles
+    on non-reentrant locks)."""
+    ir = _build_lock_ir(modules)
+    summaries = _acquire_summaries(ir)
+    lock_kinds: Dict[str, str] = {}
+    for cls in ir.classes.values():
+        for attr, kind in cls.lock_attrs.items():
+            lock_kinds[f"{cls.name}.{attr}"] = kind
+    for rel, locks in ir.module_locks.items():
+        for name, kind in locks.items():
+            lock_kinds[f"{os.path.basename(rel)}:{name}"] = kind
+
+    edges: Dict[Tuple[str, str], str] = {}  # (A, B) -> sample site
+
+    def walk(node, held: Tuple[str, ...], rel: str,
+             cls: Optional[_ClassIR], info: ModuleInfo):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in node.items:
+                got = _lock_id_of(item.context_expr, rel, cls, ir)
+                if got:
+                    acquired.append(got[0])
+                    for h in held:
+                        if not (h == got[0] and got[1] == "RLock"):
+                            edges.setdefault(
+                                (h, got[0]), f"{rel}:{node.lineno}"
+                            )
+            inner = held + tuple(a for a in acquired if a not in held)
+            for child in node.body:
+                walk(child, inner, rel, cls, info)
+            return
+        if isinstance(node, ast.Call) and held:
+            got = _resolve_call(node, rel, cls, ir)
+            if got:
+                key = _function_key(*got)
+                for m in summaries.get(key, ()):
+                    for h in held:
+                        if not (h == m and lock_kinds.get(m) == "RLock"):
+                            edges.setdefault(
+                                (h, m), f"{rel}:{node.lineno}"
+                            )
+        for child in ast.iter_child_nodes(node):
+            walk(child, held, rel, cls, info)
+
+    for rel, info in modules.items():
+        for node in info.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = ir.classes.get(node.name)
+                for item in node.body:
+                    walk(item, (), rel, cls, info)
+            else:
+                walk(node, (), rel, None, info)
+
+    # Cycle detection over the edge graph (self-edges included).
+    graph: Dict[str, Set[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    findings: List[Finding] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str]):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start:
+                cycle = tuple(sorted(path))
+                if cycle not in seen_cycles:
+                    seen_cycles.add(cycle)
+                    sites = [
+                        edges.get((path[i], path[(i + 1) % len(path)]))
+                        for i in range(len(path))
+                    ]
+                    findings.append(Finding(
+                        rule="lock-order-cycle",
+                        severity="error",
+                        location=sites[0] or "lock-graph",
+                        message=(
+                            "lock-order cycle: "
+                            + " -> ".join(path + [path[0]])
+                            + (" (non-reentrant re-acquisition)"
+                               if len(path) == 1 else
+                               " — two threads interleaving these "
+                               "acquisitions deadlock")
+                        ),
+                        details={
+                            "cycle": path + [path[0]],
+                            "sites": sites,
+                        },
+                    ))
+            elif nxt not in path and nxt > start:
+                # Only explore nodes > start so each cycle is found from
+                # its smallest node exactly once.
+                dfs(start, nxt, path + [nxt])
+
+    for a in sorted(graph):
+        dfs(a, a, [a])
+    return findings
+
+
+# -------------------------------------------------- shared-state rule
+def check_shared_state(modules: Dict[str, ModuleInfo],
+                       config: Optional[LintConfig] = None
+                       ) -> List[Finding]:
+    """Attributes guarded by a class's lock must not be assigned outside
+    it (except in ``__init__`` and in helpers only ever called under the
+    lock)."""
+    ir = _build_lock_ir(modules)
+    findings: List[Finding] = []
+    for cls in ir.classes.values():
+        if not cls.lock_attrs:
+            continue
+        info = modules[cls.module]
+
+        def assigned_attrs(node) -> List[Tuple[str, int]]:
+            out = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            else:
+                return out
+            for t in targets:
+                # self.attr = / self.attr[k] = ...
+                base = t
+                if isinstance(base, ast.Subscript):
+                    base = base.value
+                if (isinstance(base, ast.Attribute)
+                        and isinstance(base.value, ast.Name)
+                        and base.value.id == "self"
+                        and base.attr not in cls.lock_attrs):
+                    out.append((base.attr, node.lineno))
+            return out
+
+        # Pass 1: which attrs are ever assigned under the lock.
+        guarded: Set[str] = set()
+
+        def collect(node, held: bool):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                takes = any(
+                    _lock_id_of(i.context_expr, cls.module, cls, ir)
+                    for i in node.items
+                )
+                for child in node.body:
+                    collect(child, held or takes)
+                return
+            for attr, _ in assigned_attrs(node):
+                if held:
+                    guarded.add(attr)
+            for child in ast.iter_child_nodes(node):
+                collect(child, held)
+
+        for name, fn in cls.methods.items():
+            for item in fn.body:
+                collect(item, False)
+
+        # Methods treated as lock-held contexts: annotated with
+        # "# Caller holds the lock", or private AND only called from
+        # held regions / other held-context methods (fixpoint).
+        annotated = {
+            name for name, fn in cls.methods.items()
+            if any(
+                ln in info.lock_held_comment_lines
+                for ln in range(fn.lineno, fn.lineno + 8)
+            )
+        }
+        # method -> [(caller, caller-held-the-lock-at-the-call)].
+        call_sites: Dict[str, list] = {m: [] for m in cls.methods}
+
+        def record_calls(node, held: bool, caller: str):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                takes = any(
+                    _lock_id_of(i.context_expr, cls.module, cls, ir)
+                    for i in node.items
+                )
+                for child in node.body:
+                    record_calls(child, held or takes, caller)
+                return
+            if isinstance(node, ast.Call):
+                fn_node = node.func
+                if (isinstance(fn_node, ast.Attribute)
+                        and isinstance(fn_node.value, ast.Name)
+                        and fn_node.value.id == "self"
+                        and fn_node.attr in call_sites):
+                    call_sites[fn_node.attr].append((caller, held))
+            for child in ast.iter_child_nodes(node):
+                record_calls(child, held, caller)
+
+        for name, fn in cls.methods.items():
+            for item in fn.body:
+                record_calls(item, False, name)
+
+        held_context = set(annotated)
+        changed = True
+        while changed:
+            changed = False
+            for name, sites in call_sites.items():
+                if name in held_context or not name.startswith("_"):
+                    continue
+                if not sites:
+                    continue
+                if all(h or c in held_context for c, h in sites):
+                    held_context.add(name)
+                    changed = True
+
+        # Pass 2: flag unguarded assignments of guarded attrs.
+        def flag(node, held: bool, method: str):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                takes = any(
+                    _lock_id_of(i.context_expr, cls.module, cls, ir)
+                    for i in node.items
+                )
+                for child in node.body:
+                    flag(child, held or takes, method)
+                return
+            if not held and method not in ("__init__",) \
+                    and method not in held_context:
+                for attr, lineno in assigned_attrs(node):
+                    if attr in guarded and not _suppressed(
+                        info, lineno, "unguarded-shared-state"
+                    ):
+                        findings.append(Finding(
+                            rule="unguarded-shared-state",
+                            severity="error",
+                            location=f"{cls.module}:{lineno}",
+                            message=(
+                                f"{cls.name}.{method} assigns "
+                                f"self.{attr} without holding the lock "
+                                f"that guards it elsewhere"
+                            ),
+                            details={
+                                "class": cls.name, "attr": attr,
+                                "method": method,
+                            },
+                        ))
+            for child in ast.iter_child_nodes(node):
+                flag(child, held, method)
+
+        for name, fn in cls.methods.items():
+            if name == "__init__" or name in held_context:
+                continue
+            for item in fn.body:
+                flag(item, False, name)
+    return findings
+
+
+# ------------------------------------------------ host-only-module rule
+def check_host_only_modules(modules: Dict[str, ModuleInfo],
+                            config: Optional[LintConfig] = None
+                            ) -> List[Finding]:
+    cfg = config or LintConfig()
+    findings: List[Finding] = []
+    for rel, info in modules.items():
+        if not any(rel.endswith(sfx) for sfx in cfg.host_only_modules):
+            continue
+        for node in ast.walk(info.tree):
+            roots = []
+            if isinstance(node, ast.Import):
+                roots = [a.name.split(".")[0] for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                roots = [node.module.split(".")[0]]
+            if "jax" in roots and not _suppressed(
+                info, node.lineno, "device-op-in-host-module"
+            ):
+                findings.append(Finding(
+                    rule="device-op-in-host-module",
+                    severity="error",
+                    location=f"{rel}:{node.lineno}",
+                    message=(
+                        f"{rel} is a host-side scheduler/pool module on "
+                        "the serving hot path; importing jax here "
+                        "invites device dispatches into admission "
+                        "control"
+                    ),
+                    details={"module": rel},
+                ))
+    return findings
+
+
+# ------------------------------------------------------ host-sync rule
+def _qualnames(tree: ast.Module):
+    """Yield (qualname, FunctionDef) for module- and class-level defs."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{item.name}", item
+
+
+def _is_literal(node: ast.expr) -> bool:
+    return isinstance(node, (ast.Constant, ast.Num, ast.Str))
+
+
+def check_host_sync(modules: Dict[str, ModuleInfo],
+                    config: Optional[LintConfig] = None) -> List[Finding]:
+    cfg = config or LintConfig()
+    findings: List[Finding] = []
+    for rel, info in modules.items():
+        wanted = {
+            qn for sfx, qn in cfg.hot_functions if rel.endswith(sfx)
+        }
+        if not wanted:
+            continue
+        for qualname, fn in _qualnames(info.tree):
+            if qualname not in wanted:
+                continue
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = None
+                f = node.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr == "item" and not node.args:
+                        hit = ".item() fetches a device scalar"
+                    elif (f.attr == "device_get"
+                          and isinstance(f.value, ast.Name)
+                          and f.value.id == "jax"):
+                        hit = "jax.device_get blocks on the device"
+                    elif (f.attr in ("asarray", "array")
+                          and isinstance(f.value, ast.Name)
+                          and f.value.id == "np"
+                          and len(node.args) == 1
+                          and not node.keywords):
+                        hit = ("np.asarray of a device value fences "
+                               "the dispatch stream")
+                elif isinstance(f, ast.Name) and f.id == "float":
+                    if node.args and not _is_literal(node.args[0]):
+                        hit = ("float() coercion syncs if its operand "
+                               "is a device array")
+                if hit is None:
+                    continue
+                if _suppressed(info, node.lineno, "host-sync-hot-loop"):
+                    continue
+                findings.append(Finding(
+                    rule="host-sync-hot-loop",
+                    severity="warn",
+                    location=f"{rel}:{node.lineno}",
+                    message=(
+                        f"{qualname}: {hit} — annotate the intentional "
+                        "fence with '# graft-lint: sync-ok' (or "
+                        "'host-value' for provably host data), or move "
+                        "it off the hot path"
+                    ),
+                    details={"function": qualname},
+                ))
+    return findings
+
+
+# -------------------------------------------------- import-hygiene rule
+def check_unused_imports(modules: Dict[str, ModuleInfo],
+                         config: Optional[LintConfig] = None
+                         ) -> List[Finding]:
+    cfg = config or LintConfig()
+    findings: List[Finding] = []
+    for rel, info in modules.items():
+        if any(rel.endswith(sfx) for sfx in cfg.import_exempt):
+            continue
+        bindings: List[Tuple[str, int, str]] = []  # (name, line, shown-as)
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    bindings.append((name, node.lineno, a.name))
+            elif isinstance(node, ast.ImportFrom):
+                if node.module == "__future__":
+                    continue
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    name = a.asname or a.name
+                    bindings.append(
+                        (name, node.lineno,
+                         f"{node.module or '.'}.{a.name}")
+                    )
+        if not bindings:
+            continue
+        used: Set[str] = set()
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                pass  # the root is a Name node, already captured
+        # __all__ re-exports count as uses.
+        for node in info.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "__all__"
+                            for t in node.targets)
+                    and isinstance(node.value, (ast.List, ast.Tuple))):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        used.add(str(elt.value))
+        for name, lineno, shown in bindings:
+            if name in used or name == "_":
+                continue
+            if _suppressed(info, lineno, "unused-import"):
+                continue
+            findings.append(Finding(
+                rule="unused-import",
+                severity="warn",
+                location=f"{rel}:{lineno}",
+                message=f"'{shown}' imported but unused",
+                details={"name": name},
+            ))
+    return findings
+
+
+# ------------------------------------------------------------ aggregation
+def run_ast_checks(modules: Dict[str, ModuleInfo],
+                   config: Optional[LintConfig] = None) -> List[Finding]:
+    cfg = config or LintConfig()
+    findings: List[Finding] = []
+    findings += check_lock_order(modules, cfg)
+    findings += check_shared_state(modules, cfg)
+    findings += check_host_only_modules(modules, cfg)
+    findings += check_host_sync(modules, cfg)
+    findings += check_unused_imports(modules, cfg)
+    # Per-line disable= works for every rule (sync-ok/host-value are
+    # host-sync-specific aliases handled in _parse_suppressions).
+    return [
+        f for f in findings
+        if not _line_suppressed(modules, f)
+    ]
+
+
+def _line_suppressed(modules: Dict[str, ModuleInfo],
+                     finding: Finding) -> bool:
+    m = re.match(r"(.+):(\d+)$", finding.location)
+    if not m:
+        return False
+    info = modules.get(m.group(1))
+    if info is None:
+        return False
+    return _suppressed(info, int(m.group(2)), finding.rule)

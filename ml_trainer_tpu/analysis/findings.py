@@ -1,0 +1,180 @@
+"""Findings, reports, and baselines — the contract every checker shares.
+
+A static-analysis pass is only useful when its output is (a) machine
+diff-able, so CI can hard-fail on *new* findings without arguing about
+old ones, and (b) human-readable enough that the finding itself explains
+the fix.  This module owns that surface for both graft-lint front ends
+(the jaxpr contract checker and the AST lint pack):
+
+* :class:`Finding` — one violation: rule id, severity, a stable
+  ``location`` (``path:line`` for AST rules, the traced program's name
+  for jaxpr rules), a one-line message, and a details dict for the
+  machine report (byte prices, per-branch collective sequences, lock
+  cycles).
+* :class:`Report` — an ordered collection with JSON and terminal
+  rendering.
+* Baselines — ``baseline_payload`` / ``diff_against_baseline``: the
+  committed artifact (``docs/graft_lint_baseline.json``) records the
+  finding *keys* plus a fingerprint hash; the gate fails on keys not in
+  the baseline, so a clean tree stays clean and an intentional
+  suppression is an explicit artifact update, never a silent drift.
+
+Line numbers are deliberately NOT part of a finding's baseline key:
+unrelated edits move lines, and a gate that fires on every shifted line
+trains people to ignore it.  The key is (rule, file-or-program,
+message-core).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+SEVERITIES = ("error", "warn", "perf")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One static-analysis violation."""
+
+    rule: str
+    severity: str
+    location: str  # "relpath:line" (AST) or "program:<name>" (jaxpr)
+    message: str
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity must be one of {SEVERITIES}, got "
+                f"{self.severity!r}"
+            )
+
+    @property
+    def file(self) -> str:
+        """Location with the line number stripped (the baseline-stable
+        half)."""
+        return re.sub(r":\d+$", "", self.location)
+
+    def key(self) -> str:
+        """Baseline identity: stable under unrelated line shifts."""
+        return f"{self.rule}|{self.file}|{self.message}"
+
+    def as_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "location": self.location,
+            "message": self.message,
+            **({"details": self.details} if self.details else {}),
+        }
+
+
+class Report:
+    """Ordered findings + rendering.  Checkers append; the CLI renders
+    and diffs."""
+
+    def __init__(self, findings: Optional[Sequence[Finding]] = None):
+        self.findings: List[Finding] = list(findings or [])
+
+    def add(self, finding: Finding) -> None:
+        self.findings.append(finding)
+
+    def extend(self, findings: Sequence[Finding]) -> None:
+        self.findings.extend(findings)
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def by_rule(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for f in self.findings:
+            out[f.rule] = out.get(f.rule, 0) + 1
+        return out
+
+    def sorted(self) -> List[Finding]:
+        order = {s: i for i, s in enumerate(SEVERITIES)}
+        return sorted(
+            self.findings,
+            key=lambda f: (order.get(f.severity, 9), f.rule, f.location),
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "findings": [f.as_dict() for f in self.sorted()],
+            "counts": {
+                "total": len(self.findings),
+                **{s: sum(1 for f in self.findings if f.severity == s)
+                   for s in SEVERITIES},
+                "by_rule": self.by_rule(),
+            },
+        }
+
+    def render(self, max_details: int = 4) -> str:
+        """Human report: one block per finding, severity-ordered."""
+        if not self.findings:
+            return "graft-lint: clean (0 findings)"
+        lines = [f"graft-lint: {len(self.findings)} finding(s)"]
+        for f in self.sorted():
+            lines.append(f"  [{f.severity}] {f.rule} @ {f.location}")
+            lines.append(f"      {f.message}")
+            for i, (k, v) in enumerate(sorted(f.details.items())):
+                if i >= max_details:
+                    lines.append(
+                        f"      ... ({len(f.details) - max_details} more "
+                        "detail fields in the JSON report)"
+                    )
+                    break
+                lines.append(f"      {k}: {v}")
+        return "\n".join(lines)
+
+
+# ---------------------------------------------------------------- baseline
+def fingerprint(findings: Sequence[Finding]) -> str:
+    """Order-independent hash of the finding keys — the one value the
+    flight recorder attaches to dumps (`lint_baseline` context)."""
+    keys = sorted(f.key() for f in findings)
+    return hashlib.sha256("\n".join(keys).encode("utf-8")).hexdigest()[:16]
+
+
+def baseline_payload(report: Report) -> dict:
+    """The committed artifact shape (docs/graft_lint_baseline.json)."""
+    return {
+        "fingerprint": fingerprint(report.findings),
+        "keys": sorted(f.key() for f in report.findings),
+        "counts": report.by_rule(),
+    }
+
+
+def diff_against_baseline(report: Report,
+                          baseline: Optional[dict]) -> dict:
+    """New-vs-baseline decision for the gate.
+
+    ``new``: findings whose key is absent from the baseline — these fail
+    the gate.  ``fixed``: baseline keys no longer found — informational
+    (the gate prints them; refreshing the artifact is a deliberate
+    ``--update-baseline`` run).  No baseline at all means every finding
+    is new (a missing artifact must not silently pass a dirty tree).
+    """
+    known = set((baseline or {}).get("keys", []))
+    new = [f for f in report.findings if f.key() not in known]
+    current = {f.key() for f in report.findings}
+    fixed = sorted(k for k in known if k not in current)
+    return {
+        "ok": not new,
+        "new": [f.as_dict() for f in Report(new).sorted()],
+        "fixed": fixed,
+        "baseline_fingerprint": (baseline or {}).get("fingerprint"),
+        "fresh_fingerprint": fingerprint(report.findings),
+    }
+
+
+def load_baseline(path: str) -> Optional[dict]:
+    try:
+        with open(path, encoding="utf-8") as fp:
+            return json.load(fp)
+    except (OSError, ValueError):
+        return None

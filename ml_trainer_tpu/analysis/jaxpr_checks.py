@@ -1,0 +1,398 @@
+"""Jaxpr-level program contract checker — the SPMD front end of graft-lint.
+
+The repo's hand-scheduled SPMD programs (pipeline tick tables with
+``lax.switch`` dispatch, bucketed reduce-scatter, ring/Ulysses SP, the
+paged decode path) carry invariants that only hold *per compiled
+program*: every branch of a switch must issue the same collectives, the
+bf16 policy must not leak fp32 compute, donatable state buffers must
+actually be donated.  Tests enforce these dynamically, one configuration
+at a time; this module checks them statically, on the jaxpr of the very
+closures the Trainer and serving engine build (``jit.trace(...)`` /
+``jax.make_jaxpr``), before any device runs.
+
+Checks (each returns :class:`~.findings.Finding` objects):
+
+* :func:`check_collective_uniformity` — every ``cond``/``switch``
+  anywhere in the program (including inside ``shard_map`` bodies and
+  ``scan`` ticks) must issue the SAME collective sequence in every
+  branch: same primitive, same axes, same ppermute perm, same payload
+  shape/dtype.  A mismatch is the classic SPMD deadlock: devices taking
+  different branches post mismatched collectives and the program hangs
+  at scale (the pipeline tick tables are exactly this shape — idle
+  branches must stay collective-free).
+* :func:`check_dtype_policy` — under ``precision='bf16'`` no
+  ``dot_general``/``conv_general_dilated`` may consume fp32 operands
+  (compute must be bf16; precision.py casts once at the loss-fn top),
+  and no cross-replica gradient reduction (``psum``/``reduce_scatter``)
+  may run in bf16 (reductions stay fp32 — bf16 accumulation loses the
+  gradient signal the policy exists to protect).
+* :func:`audit_donation` — diff donatable input buffers (an undonated
+  input whose shape/dtype matches an otherwise-unmatched output could
+  have been aliased) against the actual ``donate_argnums``, pricing the
+  wasted bytes through the PR9 memory ledger; optionally verify against
+  the lowered module that declared donations really produced
+  input/output aliases (a silently dropped donation doubles the state's
+  HBM).
+* :func:`check_traceable` — tracing IS the host-sync check for device
+  code: ``.item()`` / ``float()`` / bool coercion of a traced array
+  raises at trace time, which this converts into a finding instead of a
+  stack trace.  (Host-side step-loop code is the AST pack's half —
+  ``ast_checks.py``.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+import jax
+
+from ml_trainer_tpu.analysis.findings import Finding
+
+# Cross-device collectives: mismatching these across switch branches (or
+# losing one on some replicas) is the deadlock class this checker exists
+# for.  pbroadcast is shard_map's replication bookkeeping, not a wire
+# collective, and axis_index is free — both excluded.
+COLLECTIVE_PRIMS = frozenset({
+    "psum", "pmax", "pmin", "ppermute", "pshuffle", "all_gather",
+    "all_to_all", "reduce_scatter", "psum_scatter", "pgather",
+})
+
+# Compute-heavy primitives the bf16 policy governs.
+_COMPUTE_PRIMS = frozenset({"dot_general", "conv_general_dilated"})
+
+# Cross-replica reductions that must stay fp32 under a bf16 policy.
+_REDUCTION_PRIMS = frozenset({"psum", "reduce_scatter", "psum_scatter"})
+
+
+# ------------------------------------------------------------- jaxpr walk
+def _as_jaxpr(obj):
+    """Unwrap ClosedJaxpr -> Jaxpr; pass Jaxpr through; else None."""
+    if hasattr(obj, "eqns"):
+        return obj
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        return inner
+    return None
+
+
+def sub_jaxprs(eqn) -> List[Tuple[str, int, Any]]:
+    """Every (param_name, index, jaxpr) nested in one equation — covers
+    cond branches, scan/while bodies, pjit/remat/custom_vjp calls and
+    shard_map bodies uniformly."""
+    out = []
+    for name, value in eqn.params.items():
+        values = value if isinstance(value, (list, tuple)) else [value]
+        for i, v in enumerate(values):
+            j = _as_jaxpr(v)
+            if j is not None:
+                out.append((name, i, j))
+    return out
+
+
+def iter_eqns(jaxpr):
+    """Depth-first over every equation in the program, branches included."""
+    j = _as_jaxpr(jaxpr)
+    if j is None:
+        return
+    for eqn in j.eqns:
+        yield eqn
+        for _, _, sub in sub_jaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def _eqn_location(eqn, program: str) -> str:
+    """``relpath:line`` of the user frame that traced this equation, or
+    the program name when source info is unavailable."""
+    try:
+        tb = eqn.source_info.traceback
+        for frame in tb.frames:
+            fname = frame.file_name
+            if "ml_trainer_tpu" in fname or "/tests/" in fname:
+                short = fname[fname.index("ml_trainer_tpu"):] if (
+                    "ml_trainer_tpu" in fname
+                ) else fname
+                return f"{short}:{frame.start_line}"
+    except Exception:
+        pass
+    return f"program:{program}"
+
+
+def collective_signature(eqn) -> dict:
+    """What must match across switch branches for one collective: the
+    primitive, the mesh axes, the ppermute perm, and the payload
+    shape/dtype (a psum of f32[8,4] and a psum of f32[4] are different
+    wire programs)."""
+    p = eqn.params
+    axes = p.get("axes", p.get("axis_name"))
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(str(a) for a in axes)
+    else:
+        axes = (str(axes),)
+    sig = {
+        "op": eqn.primitive.name,
+        "axes": axes,
+        "payload": tuple(
+            str(v.aval) for v in eqn.invars if hasattr(v, "aval")
+        ),
+    }
+    if "perm" in p:
+        sig["perm"] = tuple(tuple(pair) for pair in p["perm"])
+    return sig
+
+
+def collective_sequence(jaxpr) -> List[dict]:
+    """Ordered collective signatures of a (sub)program, recursing into
+    everything — for a switch branch this is exactly 'what the branch
+    posts on the wire, in order'."""
+    return [
+        collective_signature(e)
+        for e in iter_eqns(jaxpr)
+        if e.primitive.name in COLLECTIVE_PRIMS
+    ]
+
+
+# ----------------------------------------------------- collective checker
+def check_collective_uniformity(jaxpr, program: str) -> List[Finding]:
+    """Every ``cond`` (which ``lax.switch`` lowers to) must issue the
+    same collective sequence in every branch."""
+    findings: List[Finding] = []
+    for eqn in iter_eqns(jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = eqn.params.get("branches") or ()
+        seqs = [collective_sequence(b) for b in branches]
+        if not any(seqs):
+            continue
+        if all(s == seqs[0] for s in seqs[1:]):
+            continue
+        findings.append(Finding(
+            rule="collective-mismatch",
+            severity="error",
+            location=_eqn_location(eqn, program),
+            message=(
+                f"switch branches issue mismatched collective sequences "
+                f"in {program} — devices taking different branches will "
+                f"deadlock"
+            ),
+            details={
+                "program": program,
+                "branch_collectives": [
+                    [f"{s['op']}{list(s['axes'])}"
+                     + (f" perm={s['perm']}" if "perm" in s else "")
+                     + f" {'/'.join(s['payload'])}"
+                     for s in seq]
+                    for seq in seqs
+                ],
+            },
+        ))
+    return findings
+
+
+# --------------------------------------------------------- dtype checker
+def check_dtype_policy(jaxpr, program: str,
+                       policy: str = "bf16") -> List[Finding]:
+    """bf16-policy conformance: compute in bf16, reductions in fp32.
+
+    ``policy='fp32'`` programs are exempt by definition (the fp32 path
+    is pinned bit-identical to the pre-policy program; there is nothing
+    to conform to)."""
+    if policy not in ("bf16", "bfloat16", "mixed_bf16"):
+        return []
+    findings: List[Finding] = []
+    for eqn in iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name in _COMPUTE_PRIMS:
+            op_dtypes = {
+                str(v.aval.dtype) for v in eqn.invars
+                if hasattr(v, "aval") and hasattr(v.aval, "dtype")
+            }
+            if "float32" in op_dtypes:
+                findings.append(Finding(
+                    rule="fp32-compute-under-bf16",
+                    severity="error",
+                    location=_eqn_location(eqn, program),
+                    message=(
+                        f"{name} consumes fp32 operands in the bf16 "
+                        f"program {program} — the precision policy casts "
+                        "compute to bf16 at the loss-fn top; an fp32 "
+                        "matmul here halves MXU throughput silently"
+                    ),
+                    details={
+                        "program": program,
+                        "primitive": name,
+                        "operand_dtypes": sorted(op_dtypes),
+                        "shapes": [
+                            str(v.aval) for v in eqn.invars
+                            if hasattr(v, "aval")
+                        ],
+                    },
+                ))
+        elif name in _REDUCTION_PRIMS:
+            op_dtypes = {
+                str(v.aval.dtype) for v in eqn.invars
+                if hasattr(v, "aval") and hasattr(v.aval, "dtype")
+            }
+            if "bfloat16" in op_dtypes:
+                findings.append(Finding(
+                    rule="bf16-gradient-reduction",
+                    severity="error",
+                    location=_eqn_location(eqn, program),
+                    message=(
+                        f"{name} reduces bf16 values across replicas in "
+                        f"{program} — gradient reductions stay fp32 "
+                        "(precision.py): bf16 accumulation flushes the "
+                        "small gradients the loss scale exists to keep"
+                    ),
+                    details={"program": program, "primitive": name},
+                ))
+    return findings
+
+
+# ------------------------------------------------------- donation auditor
+def _aval_bytes(shape, dtype) -> int:
+    from ml_trainer_tpu.telemetry.memory import nbytes_of
+
+    return nbytes_of(shape, dtype)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", None)
+        if key is None:
+            key = getattr(p, "idx", None)
+        if key is None:
+            key = getattr(p, "name", None)
+        parts.append(str(key) if key is not None else str(p))
+    return "/".join(parts) or "<arg>"
+
+
+def audit_donation(traced, program: str, min_bytes: int = 1 << 16,
+                   lowered_text: Optional[str] = None) -> List[Finding]:
+    """Donation/aliasing audit of one traced program.
+
+    ``traced`` is the ``jax.jit(...).trace(*args)`` result: its
+    ``args_info`` carries per-leaf donated flags, its jaxpr carries the
+    output avals.  An input leaf is *donatable-but-undonated* when it is
+    not donated, at least ``min_bytes`` big, and its (shape, dtype)
+    matches an output aval not already claimed by a donated input — XLA
+    could have aliased it and reused the buffer, so the undonated copy
+    is pure HBM waste, priced here through the memory ledger.
+
+    With ``lowered_text`` (``traced.lower().as_text()``) the audit also
+    verifies declared donations materialized as input/output aliases
+    (``tf.aliasing_output``): jax silently drops donation when layouts
+    or shardings prevent aliasing, which doubles the state's footprint
+    without any visible error.
+    """
+    flat_info = jax.tree_util.tree_flatten_with_path(traced.args_info)[0]
+    out_avals = [
+        (tuple(a.shape), str(a.dtype))
+        for a in traced.jaxpr.out_avals
+        if hasattr(a, "shape")
+    ]
+    # Outputs still available for aliasing = all outputs minus one slot
+    # per donated input of that (shape, dtype).
+    pool: dict = {}
+    for key in out_avals:
+        pool[key] = pool.get(key, 0) + 1
+    donated_total = 0
+    for _, info in flat_info:
+        if getattr(info, "donated", False):
+            donated_total += 1
+            key = (tuple(info.shape), str(info.dtype))
+            if pool.get(key, 0) > 0:
+                pool[key] -= 1
+    findings: List[Finding] = []
+    wasted: List[Tuple[str, int]] = []
+    for path, info in flat_info:
+        if getattr(info, "donated", False):
+            continue
+        shape = tuple(getattr(info, "shape", ()) or ())
+        dtype = str(getattr(info, "dtype", ""))
+        nbytes = _aval_bytes(shape, dtype) if dtype else 0
+        if nbytes < min_bytes:
+            continue
+        key = (shape, dtype)
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            wasted.append((_path_str(path), nbytes))
+    if wasted:
+        total = sum(b for _, b in wasted)
+        findings.append(Finding(
+            rule="undonated-buffer",
+            severity="perf",
+            location=f"program:{program}",
+            message=(
+                f"{len(wasted)} donatable input buffer(s) not donated in "
+                f"{program} — {total / 2 ** 20:.2f} MiB of aliasable "
+                "state held twice across the dispatch"
+            ),
+            details={
+                "program": program,
+                "undonated_bytes": total,
+                "buffers": [
+                    {"arg": p, "bytes": b}
+                    for p, b in sorted(wasted, key=lambda x: -x[1])[:16]
+                ],
+            },
+        ))
+    if lowered_text is not None and donated_total:
+        aliased = lowered_text.count("tf.aliasing_output")
+        if aliased == 0:
+            findings.append(Finding(
+                rule="donation-dropped",
+                severity="error",
+                location=f"program:{program}",
+                message=(
+                    f"{program} declares {donated_total} donated "
+                    "argument(s) but the lowered module aliases none of "
+                    "them — donation was silently dropped (layout or "
+                    "sharding mismatch), doubling the state's HBM"
+                ),
+                details={"program": program, "declared": donated_total},
+            ))
+    return findings
+
+
+# --------------------------------------------------------- trace checker
+def check_traceable(build_trace, program: str) -> List[Finding]:
+    """Run ``build_trace()`` (a thunk returning a Traced / jaxpr) and
+    convert trace-time concretization errors — ``.item()``, ``float()``,
+    ``if`` on a traced array — into a host-sync finding.  Device code
+    that traces clean cannot host-sync by construction."""
+    try:
+        build_trace()
+        return []
+    except Exception as e:  # ConcretizationTypeError and friends
+        name = type(e).__name__
+        if "Concretization" not in name and "TracerBool" not in name \
+                and "Tracer" not in name:
+            raise
+        return [Finding(
+            rule="host-sync-in-program",
+            severity="error",
+            location=f"program:{program}",
+            message=(
+                f"tracing {program} forced a device value to the host "
+                "(.item()/float()/bool on a traced array) — a per-step "
+                "sync inside the compiled region"
+            ),
+            details={"program": program, "error": str(e).split("\n")[0]},
+        )]
+
+
+# ------------------------------------------------------------ aggregation
+def check_program(traced, program: str, *, policy: str = "fp32",
+                  min_donation_bytes: int = 1 << 16,
+                  lowered_text: Optional[str] = None) -> List[Finding]:
+    """All jaxpr checks over one traced program."""
+    jaxpr = traced.jaxpr
+    findings = []
+    findings += check_collective_uniformity(jaxpr, program)
+    findings += check_dtype_policy(jaxpr, program, policy)
+    findings += audit_donation(
+        traced, program, min_bytes=min_donation_bytes,
+        lowered_text=lowered_text,
+    )
+    return findings

@@ -1,0 +1,260 @@
+"""Real-program builders: the closures graft-lint's jaxpr checks trace.
+
+A contract checker that validates hand-written fixture programs proves
+nothing about the tree; these builders construct the SAME closures the
+Trainer and the serving engine run in production — ``Trainer.__init__``
+builds ``_train_step`` (fused or sharded, fp32 or bf16), a
+``SlotDecodeEngine`` builds its decode / prefill / paged-continuation /
+verify programs — and hand each back as a :class:`ProgramSpec` carrying
+the ``jit.trace(...)`` result (jaxpr + per-arg donation flags, NO
+compilation) plus the policy the checkers should hold it to.
+
+Everything is sized for tracing speed (MLModel on synthetic CIFAR,
+gpt2_tiny serving at ``max_len=64``): tracing is shape arithmetic, so
+the contracts verified here are the same ones the full-size programs
+carry — the structure of the jaxpr does not depend on widths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import tempfile
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class ProgramSpec:
+    """One traced program + the contract knobs the checkers need."""
+
+    name: str
+    traced: Any                       # jax.stages.Traced
+    policy: str = "fp32"              # dtype policy the program runs under
+    min_donation_bytes: int = 1 << 16
+    # Thunk producing the lowered module text (for the aliasing audit);
+    # None skips that half (lowering costs more than tracing).
+    lower_text: Optional[Callable[[], str]] = None
+
+
+def _lower_text_thunk(traced):
+    def thunk():
+        return traced.lower().as_text()
+
+    return thunk
+
+
+# ------------------------------------------------------------- train side
+def build_train_specs(precisions=("fp32", "bf16"),
+                      with_lowered: bool = False,
+                      sharded: Optional[bool] = None) -> List[ProgramSpec]:
+    """Trace the Trainer's per-batch train step and eval step for each
+    precision policy — the very ``self._train_step`` the epoch loop
+    dispatches.  With ``sharded`` (default: whenever >= 2 devices) the
+    PR7 ``dp_update='sharded'`` flavor is traced too at bf16: the
+    bucketed reduce-scatter + sharded update + per-bucket all-gather is
+    where the collective walk and the bf16-reduction rule have real
+    targets."""
+    from ml_trainer_tpu import MLModel, Trainer
+    from ml_trainer_tpu.data import SyntheticCIFAR10
+    from ml_trainer_tpu.utils.functions import custom_pre_process_function
+
+    if sharded is None:
+        sharded = jax.device_count() >= 2
+    specs: List[ProgramSpec] = []
+    t0 = custom_pre_process_function()
+    flavors = [(p, "fused") for p in precisions]
+    if sharded:
+        flavors.append(("bf16", "sharded"))
+
+    def sets():
+        return (
+            SyntheticCIFAR10(size=32, seed=0, transform=t0),
+            SyntheticCIFAR10(size=16, seed=1, transform=t0),
+        )
+
+    for precision, dp_update in flavors:
+        extra = {}
+        label = precision
+        if dp_update == "sharded":
+            # The mesh must cover the host's devices (2 in the CLI's
+            # forced-virtual-device process, 8 on the test harness).
+            extra = {
+                "dp_update": "sharded",
+                "mesh_shape": {"data": jax.device_count()},
+            }
+            label = f"{precision},sharded"
+        trainer = Trainer(
+            MLModel(), datasets=sets(),
+            epochs=1, batch_size=16, lr=0.01, optimizer="adamw",
+            metric=None, precision=precision,
+            model_dir=tempfile.mkdtemp(prefix="graft_lint_train_"),
+            **extra,
+        )
+        x, y = next(iter(trainer.train_loader))
+        lr_scale = jnp.asarray(1.0, jnp.float32)
+        traced = trainer._train_step.trace(
+            trainer.state, jnp.asarray(x), jnp.asarray(y), lr_scale
+        )
+        specs.append(ProgramSpec(
+            name=f"train_step[{label}]",
+            traced=traced,
+            policy=precision,
+            lower_text=_lower_text_thunk(traced) if with_lowered else None,
+        ))
+        if dp_update == "sharded":
+            continue  # one eval step per precision is enough
+        ev = trainer._eval_step.trace(
+            trainer._state_variables(), jnp.asarray(x), jnp.asarray(y)
+        )
+        specs.append(ProgramSpec(
+            name=f"eval_step[{label}]",
+            traced=ev,
+            policy=precision,
+        ))
+    return specs
+
+
+# ------------------------------------------------------------ decode side
+def _tiny_lm(max_len: int = 64):
+    from ml_trainer_tpu.models import get_model
+
+    model = get_model("gpt2_tiny", max_len=max_len)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)},
+        np.zeros((1, 8), np.int32), train=False,
+    )
+    return model, variables
+
+
+def build_decode_specs(paged: bool = True, spec_k: int = 2,
+                       with_lowered: bool = False) -> List[ProgramSpec]:
+    """Trace the serving engine's compiled programs: the contiguous
+    decode step, its paged twin, a prefill bucket, the paged
+    continuation window, and the speculative verify step — each pulled
+    off a real ``SlotDecodeEngine`` so the traced closure IS the served
+    one."""
+    from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+
+    model, variables = _tiny_lm()
+    specs: List[ProgramSpec] = []
+
+    def decode_args(eng):
+        return (eng.params, eng.cache, eng.tok, eng._temps, eng._rngs,
+                eng._steps)
+
+    eng = SlotDecodeEngine(model, variables, max_batch=2)
+    traced = eng._decode.trace(*decode_args(eng))
+    specs.append(ProgramSpec(
+        name="serve_decode[contiguous]", traced=traced,
+        lower_text=_lower_text_thunk(traced) if with_lowered else None,
+    ))
+    # The contiguous batch-1 prefill at one representative bucket.
+    bucket = 8
+    prefill = eng._program(
+        ("serve_prefill", eng.model, bucket),
+        lambda: eng._build_prefill(bucket),
+    )
+    specs.append(ProgramSpec(
+        name=f"serve_prefill[b{bucket}]",
+        traced=prefill.trace(
+            eng.params, np.zeros((1, bucket), np.int32), np.int32(5),
+            jnp.asarray(0.0, jnp.float32),
+            np.zeros((2,), np.uint32), np.int32(0),
+        ),
+    ))
+
+    if paged:
+        peng = SlotDecodeEngine(
+            model, variables, max_batch=2, kv_page_size=16,
+        )
+        traced_p = peng._decode.trace(*decode_args(peng))
+        specs.append(ProgramSpec(
+            name="serve_decode[paged]", traced=traced_p,
+            lower_text=_lower_text_thunk(traced_p) if with_lowered
+            else None,
+        ))
+        cont = peng._program(
+            ("serve_prefill_paged", peng._key_model, bucket),
+            lambda: peng._build_prefill_paged(bucket),
+        )
+        specs.append(ProgramSpec(
+            name=f"serve_prefill_paged[b{bucket}]",
+            traced=cont.trace(
+                peng.cache, peng.tok, peng.params,
+                np.zeros((1, bucket), np.int32), np.int32(5),
+                np.int32(16), np.zeros((4,), np.int32),
+                jnp.asarray(0.0, jnp.float32),
+                np.zeros((2,), np.uint32), np.int32(0), np.int32(0),
+            ),
+        ))
+
+    if spec_k:
+        seng = SlotDecodeEngine(
+            model, variables, max_batch=2, spec_k=spec_k,
+        )
+        specs.append(ProgramSpec(
+            name=f"spec_verify[k{spec_k}]",
+            traced=seng._verify.trace(
+                seng.params, seng.cache,
+                jnp.zeros((2, spec_k + 1), jnp.int32),
+                jnp.asarray(seng._pos), jnp.asarray(seng._caps),
+                seng._temps, seng._rngs, seng._steps,
+            ),
+        ))
+    return specs
+
+
+# ---------------------------------------------------------- pipeline side
+def build_pipeline_specs(schedule: str = "1f1b",
+                         n_micro: int = 4) -> List[ProgramSpec]:
+    """Trace the tick-table pipeline engine's train program — the one
+    place in the tree where ``lax.switch`` dispatch and ``ppermute``
+    hops coexist, i.e. the program the collective-uniformity check
+    exists for.  Needs >= 2 devices (a stage mesh); returns [] on a
+    single-device host so the CLI degrades instead of failing."""
+    if jax.device_count() < 2:
+        return []
+    from ml_trainer_tpu.parallel import create_mesh
+    from ml_trainer_tpu.parallel.pipeline import (
+        pipeline_apply,
+        stack_stage_params,
+    )
+
+    mesh = create_mesh({"stage": 2}, devices=jax.devices()[:2])
+    d = 8
+    key = jax.random.PRNGKey(0)
+    stage_params = stack_stage_params([
+        {"w": jax.random.normal(jax.random.fold_in(key, s), (d, d))
+              / np.sqrt(d)}
+        for s in range(2)
+    ])
+
+    def stage_fn(p, mb):
+        return jnp.tanh(mb @ p["w"])
+
+    def loss(p, x):
+        return pipeline_apply(
+            stage_fn, p, x, mesh, schedule=schedule,
+            n_microbatches=n_micro,
+        ).sum()
+
+    x = jnp.ones((n_micro * 2, d))
+    traced = jax.jit(jax.value_and_grad(loss)).trace(stage_params, x)
+    return [ProgramSpec(
+        name=f"pipeline_train[{schedule}]",
+        traced=traced,
+        # Grad-of-params probe, not a full optimizer step: params are
+        # live after it, so nothing here is donatable by design.
+        min_donation_bytes=1 << 20,
+    )]
+
+
+def build_all_specs(with_lowered: bool = False) -> List[ProgramSpec]:
+    return (
+        build_train_specs(with_lowered=with_lowered)
+        + build_decode_specs(with_lowered=with_lowered)
+        + build_pipeline_specs()
+    )

@@ -24,7 +24,7 @@ from typing import Iterator, Optional, Tuple
 import jax
 import numpy as np
 
-from ml_trainer_tpu.data.datasets import ArrayDataset, Dataset, as_dataset
+from ml_trainer_tpu.data.datasets import Dataset, as_dataset
 from ml_trainer_tpu.data.sampler import ShardedSampler
 
 

@@ -24,7 +24,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ml_trainer_tpu.models.layers import TransformerBlock, remat_block
+from ml_trainer_tpu.models.layers import remat_block
 from ml_trainer_tpu.models.registry import register_model
 
 

@@ -12,7 +12,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from ml_trainer_tpu.models.layers import TransformerBlock, remat_block
+from ml_trainer_tpu.models.layers import remat_block
 from ml_trainer_tpu.models.registry import register_model
 
 

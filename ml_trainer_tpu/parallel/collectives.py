@@ -19,7 +19,6 @@ from __future__ import annotations
 
 from typing import Union, Sequence
 
-import jax
 from jax import lax
 
 from ml_trainer_tpu.parallel.comm_stats import account as _account

@@ -24,7 +24,7 @@ from jax import lax
 
 from ml_trainer_tpu.parallel.collectives import ppermute_ring
 from ml_trainer_tpu.parallel.comm_stats import account as _comm_account
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from ml_trainer_tpu.parallel.compat import axis_size, shard_map
 
 

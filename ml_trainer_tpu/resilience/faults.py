@@ -42,7 +42,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 ENV_VAR = "ML_TRAINER_TPU_FAULTS"

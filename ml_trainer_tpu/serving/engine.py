@@ -922,6 +922,8 @@ class SlotDecodeEngine:
                 self.params, self.cache, self.tok,
                 self._temps, self._rngs, self._steps,
             )
+            # The step's ONE fence: every later read this iteration is
+            # host data.  # graft-lint: sync-ok
             toks = np.asarray(self.tok[:, 0])  # blocks: the step landed
         dt = time.perf_counter() - t0
         # Host mirror of the device's idx += 1 (every row advances).
@@ -969,6 +971,8 @@ class SlotDecodeEngine:
                     self._draft.params, self._draft_cache, self.tok,
                     jnp.asarray(self._pos),
                 )
+                # Draft fence: the verify window needs the drafted ids
+                # on the host.  # graft-lint: sync-ok
                 drafts = np.asarray(drafts_dev)
             else:
                 # Per-slot draft state: the lookup history is the
@@ -990,7 +994,8 @@ class SlotDecodeEngine:
                 jnp.asarray(self._caps), self._temps, self._rngs,
                 self._steps,
             )
-            acc = np.asarray(accepted)
+            acc = np.asarray(accepted)  # graft-lint: sync-ok
+            # graft-lint: sync-ok (the verify step's one fence)
             toks = np.asarray(self.tok[:, 0])  # blocks: the step landed
         dt = time.perf_counter() - t0
         freed: List[int] = []

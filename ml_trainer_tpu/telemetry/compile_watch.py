@@ -43,7 +43,7 @@ import dataclasses
 import logging
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Dict, List, Optional
 
 from ml_trainer_tpu.utils.logging import get_logger
 

@@ -43,7 +43,6 @@ never allocates, syncs, or changes a compiled program.
 from __future__ import annotations
 
 import dataclasses
-import math
 import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -137,13 +136,21 @@ class MemoryLedger:
 
 
 # ------------------------------------------------------------ tree walks
+def nbytes_of(shape, dtype) -> int:
+    """Bytes of one (shape, dtype) pair — the ledger's unit price.  The
+    graft-lint donation audit (analysis/jaxpr_checks.py) prices
+    undonated-but-aliasable buffers through this, so lint findings and
+    ledger components quote the same arithmetic."""
+    if dtype is None:
+        return 0
+    return int(np.prod(shape, initial=1)) * jnp.dtype(dtype).itemsize
+
+
 def _leaf_bytes(leaf) -> float:
     """Global bytes of one shape/dtype carrier (array or ShapeDtypeStruct)."""
-    shape = getattr(leaf, "shape", ())
-    dtype = getattr(leaf, "dtype", None)
-    if dtype is None:
-        return 0.0
-    return float(np.prod(shape, initial=1)) * jnp.dtype(dtype).itemsize
+    return float(
+        nbytes_of(getattr(leaf, "shape", ()), getattr(leaf, "dtype", None))
+    )
 
 
 def _leaf_device_bytes(leaf, sharding=None) -> float:

@@ -46,6 +46,14 @@ floor, and the 1F1B steps/s ratchets against the committed
 ``docs/pipeline_schedules_cpu.json`` artifact / this machine's
 baseline.
 
+A sixth leg (``gate_lint``, skip with ``--skip-lint``) gates the
+graft-lint static analysis: the jaxpr contract checks over the traced
+train/decode/pipeline programs and the AST concurrency/hygiene pack
+must report no finding absent from the committed
+``docs/graft_lint_baseline.json`` (zero findings on a clean tree) —
+new SPMD deadlock / precision / donation / lock-order findings are
+hard failures before any device runs.
+
 Exit non-zero = regression.  Threshold override:
 ``ML_TRAINER_TPU_BENCH_GATE_THRESHOLD`` (fraction, e.g. ``0.15``).
 """
@@ -536,6 +544,83 @@ def gate_goodput(threshold: float) -> dict:
     return out
 
 
+def committed_lint_baseline(repo: str = REPO):
+    """The committed graft-lint baseline artifact, or None."""
+    path = os.path.join(repo, "docs", "graft_lint_baseline.json")
+    try:
+        return json.load(open(path))
+    except (OSError, ValueError):
+        return None
+
+
+def gate_lint() -> dict:
+    """The static-analysis gate (graft-lint): re-runs
+    ``scripts/graft_lint.py`` in a subprocess (it forces its own
+    2-virtual-device process for the pipeline trace) and enforces
+
+    1. **Invariants** (hard): every program traces (a trace failure IS
+       a host-sync/contract finding) and the AST pack parses the tree;
+    2. **Findings-vs-baseline** (hard): any finding not in the committed
+       ``docs/graft_lint_baseline.json`` fails — the clean tree stays
+       clean, and accepting a new finding is a deliberate
+       ``--update-baseline`` artifact diff, never silent drift.
+    """
+    import subprocess
+    import tempfile
+
+    script = os.path.join(REPO, "scripts", "graft_lint.py")
+    out_json = os.path.join(
+        tempfile.mkdtemp(prefix="graft_lint_gate_"), "report.json"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, "--json", out_json],
+            capture_output=True, text=True, timeout=280,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "decided_by": "worker",
+                "error": "graft_lint.py timed out"}
+    try:
+        report = json.load(open(out_json))
+    except (OSError, ValueError):
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-8:]
+        return {"ok": False, "decided_by": "worker",
+                "error": "graft_lint produced no report: "
+                + " | ".join(tail)}
+    diff = report.get("baseline") or {}
+    out = {
+        "programs_traced": len(report.get("programs_traced") or []),
+        "findings": (report.get("counts") or {}).get("total", -1),
+        "new_findings": len(diff.get("new") or []),
+        "baseline_fingerprint": diff.get("baseline_fingerprint"),
+    }
+    baseline = committed_lint_baseline()
+    if baseline is None:
+        out.update(ok=False, decided_by="baseline_missing",
+                   error="docs/graft_lint_baseline.json is missing — "
+                   "regenerate with scripts/graft_lint.py "
+                   "--update-baseline on a clean tree")
+        return out
+    if proc.returncode != 0 or diff.get("ok") is not True:
+        new = diff.get("new") or []
+        out.update(
+            ok=False, decided_by="findings_vs_baseline",
+            error=f"{len(new)} new graft-lint finding(s): "
+            + "; ".join(
+                f"{f['rule']} @ {f['location']}" for f in new[:6]
+            ),
+        )
+        return out
+    out.update(ok=True, decided_by="findings_vs_baseline")
+    if diff.get("fixed"):
+        out["note"] = (
+            f"{len(diff['fixed'])} baseline finding(s) fixed — refresh "
+            "the baseline artifact when intentional"
+        )
+    return out
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--threshold", type=float, default=float(
@@ -556,6 +641,8 @@ def main() -> int:
     parser.add_argument("--skip-goodput", action="store_true",
                         help="skip the memory-ledger / goodput / "
                         "recompile gate")
+    parser.add_argument("--skip-lint", action="store_true",
+                        help="skip the graft-lint static-analysis gate")
     args = parser.parse_args()
 
     import jax
@@ -649,6 +736,19 @@ def main() -> int:
             f"{len(gp['configs'])} ledger configs agree, goodput "
             f"{gp['goodput_fraction']}, "
             f"{gp['post_warmup_compiles']} post-warmup compiles",
+            flush=True,
+        )
+    if not args.skip_lint:
+        lint = gate_lint()
+        print(json.dumps({"bench_gate_lint": lint}), flush=True)
+        if not lint["ok"]:
+            print(f"BENCH_GATE LINT FAIL: {lint.get('error')}", flush=True)
+            return 1
+        print(
+            f"BENCH_GATE LINT OK ({lint['decided_by']}): "
+            f"{lint['programs_traced']} programs traced, "
+            f"{lint['findings']} finding(s), 0 new vs baseline "
+            f"{lint['baseline_fingerprint']}",
             flush=True,
         )
     return 0

@@ -19,6 +19,12 @@
 # ledger within 10% of measured state bytes on pure-DP / ZeRO-1 /
 # pipeline configs, goodput bucket arithmetic, zero post-warmup
 # compiles), and a bench
+# graft-lint static-analysis leg (scripts/graft_lint.py: jaxpr
+# contract checks over the traced train/decode/pipeline programs +
+# the AST concurrency/hygiene pack, hard-failed against the committed
+# docs/graft_lint_baseline.json), a ruff import-hygiene leg (pyproject
+# [tool.ruff]; skipped when ruff is not installed — graft-lint's
+# unused-import rule enforces the F401 subset either way), and a bench
 # regression gate (scripts/bench_gate.py) that fails on >10% samples/s
 # regression vs the committed BENCH trajectory / this machine's
 # calibrated baseline — plus the paged-serving replay gate (byte
@@ -64,6 +70,20 @@ echo "# memory ledger / goodput / recompile smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py
 memory_rc=$?
 [ $memory_rc -ne 0 ] && echo "# memory smoke FAILED (rc=$memory_rc)"
+echo "# graft-lint static-analysis leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/graft_lint.py
+lint_rc=$?
+[ $lint_rc -ne 0 ] && echo "# graft-lint FAILED (rc=$lint_rc)"
+echo "# ruff import-hygiene leg (when installed; graft-lint's"
+echo "# unused-import rule covers the F401 subset regardless)"
+if command -v ruff >/dev/null 2>&1; then
+  ruff check ml_trainer_tpu scripts
+  ruff_rc=$?
+  [ $ruff_rc -ne 0 ] && echo "# ruff FAILED (rc=$ruff_rc)"
+else
+  echo "# ruff not installed; skipped"
+  ruff_rc=0
+fi
 echo "# bench regression gate"
 timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
@@ -75,5 +95,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$mixed_rc
 [ $rc -eq 0 ] && rc=$pipeline_rc
 [ $rc -eq 0 ] && rc=$memory_rc
+[ $rc -eq 0 ] && rc=$lint_rc
+[ $rc -eq 0 ] && rc=$ruff_rc
 [ $rc -eq 0 ] && rc=$gate_rc
 exit $rc

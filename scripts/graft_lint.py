@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""graft-lint CLI — jaxpr contract checks + AST lint over the real tree.
+
+Runs both front ends of ``ml_trainer_tpu/analysis/``:
+
+1. traces the ACTUAL train/eval/decode/prefill/verify programs (the
+   same closures Trainer and SlotDecodeEngine build — tracing only,
+   nothing compiles or executes on a device) and checks collective
+   uniformity, bf16 dtype policy, donation/aliasing, host syncs;
+2. parses ``ml_trainer_tpu/`` + ``scripts/`` and runs the concurrency
+   and hygiene lints (lock-order cycles, unguarded shared state, device
+   ops in host modules, hot-loop host syncs, unused imports).
+
+Exit status: 0 when the findings match the committed baseline
+(``docs/graft_lint_baseline.json`` — zero findings on a clean tree),
+1 when NEW findings appeared.  ``--update-baseline`` rewrites the
+artifact (a deliberate act, reviewed like any other diff).
+
+    python scripts/graft_lint.py              # human report + gate
+    python scripts/graft_lint.py --json out.json
+    python scripts/graft_lint.py --ast-only   # skip program tracing
+    python scripts/graft_lint.py --update-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Two virtual devices so the pipeline program (the lax.switch + ppermute
+# composition the collective checker targets) is traceable on any host.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def gather_findings(ast_only: bool = False, jaxpr_only: bool = False,
+                    with_lowered: bool = True):
+    from ml_trainer_tpu import analysis
+
+    report = analysis.Report()
+    programs = []
+    if not ast_only:
+        from ml_trainer_tpu.analysis import jaxpr_checks, programs as P
+
+        # Tracing each group IS the host-sync check for device code: a
+        # .item()/float() inside a step closure raises at trace time and
+        # lands as a host-sync-in-program finding, not a stack trace.
+        groups = (
+            ("train", lambda: P.build_train_specs(
+                with_lowered=with_lowered)),
+            ("decode", lambda: P.build_decode_specs(
+                with_lowered=with_lowered)),
+            ("pipeline", P.build_pipeline_specs),
+        )
+        for group_name, build in groups:
+            specs: list = []
+            report.extend(jaxpr_checks.check_traceable(
+                lambda b=build, s=specs: s.extend(b()), group_name,
+            ))
+            for spec in specs:
+                programs.append(spec.name)
+                lowered = spec.lower_text() if spec.lower_text else None
+                report.extend(jaxpr_checks.check_program(
+                    spec.traced, spec.name, policy=spec.policy,
+                    min_donation_bytes=spec.min_donation_bytes,
+                    lowered_text=lowered,
+                ))
+    if not jaxpr_only:
+        modules = analysis.scan_tree(REPO)
+        report.extend(analysis.run_ast_checks(modules))
+    return report, programs
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--json", metavar="PATH",
+                        help="write the machine-readable report here "
+                        "('-' for stdout)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline artifact (default: "
+                        "docs/graft_lint_baseline.json)")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline from this run's "
+                        "findings")
+    parser.add_argument("--ast-only", action="store_true",
+                        help="skip program tracing (fast host-code lint)")
+    parser.add_argument("--jaxpr-only", action="store_true",
+                        help="skip the AST pack (program contracts only)")
+    parser.add_argument("--no-lower", action="store_true",
+                        help="skip the lowered-module aliasing "
+                        "verification (faster)")
+    args = parser.parse_args()
+
+    from ml_trainer_tpu import analysis
+
+    report, programs = gather_findings(
+        ast_only=args.ast_only, jaxpr_only=args.jaxpr_only,
+        with_lowered=not args.no_lower,
+    )
+    baseline_path = args.baseline or analysis.default_baseline_path()
+
+    if args.update_baseline:
+        payload = analysis.baseline_payload(report)
+        os.makedirs(os.path.dirname(baseline_path), exist_ok=True)
+        with open(baseline_path, "w", encoding="utf-8") as fp:
+            json.dump(payload, fp, indent=1, sort_keys=True)
+            fp.write("\n")
+        print(f"baseline updated: {baseline_path} "
+              f"({len(report)} finding(s), "
+              f"fingerprint {payload['fingerprint']})")
+
+    baseline = analysis.load_baseline(baseline_path)
+    diff = analysis.diff_against_baseline(report, baseline)
+
+    machine = {
+        "programs_traced": programs,
+        **report.as_dict(),
+        "baseline": diff,
+    }
+    if args.json == "-":
+        print(json.dumps(machine, indent=1))
+    elif args.json:
+        with open(args.json, "w", encoding="utf-8") as fp:
+            json.dump(machine, fp, indent=1)
+        print(f"# report written: {args.json}")
+
+    print(report.render())
+    if programs:
+        print(f"# traced {len(programs)} program(s): "
+              + ", ".join(programs))
+    if baseline is None:
+        print("# no baseline artifact — every finding counts as new "
+              "(run --update-baseline on a clean tree)")
+    if diff["fixed"]:
+        print(f"# {len(diff['fixed'])} baseline finding(s) no longer "
+              "present — refresh the baseline when intentional:")
+        for key in diff["fixed"]:
+            print(f"#   fixed: {key}")
+    if not diff["ok"]:
+        print(f"GRAFT_LINT FAIL: {len(diff['new'])} new finding(s) vs "
+              f"baseline {diff['baseline_fingerprint']}")
+        return 1
+    print(f"GRAFT_LINT OK: {len(report)} finding(s), all in baseline "
+          f"(fingerprint {diff['fresh_fingerprint']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
