@@ -565,6 +565,135 @@ def bench_serve_replay(n_requests=48, n_tenants=3, shared_frac=0.8,
     return result
 
 
+def bench_slo(rates=(40.0, 120.0, 360.0, 720.0), n_requests=36, seed=0,
+              ttft_ms=50.0, tpot_ms=25.0, max_batch=8, page_size=16,
+              out_path=None):
+    """Open-loop SLO sweep (docs/observability.md "Serving SLO"): fixed
+    Poisson arrival schedules at ``rates`` offered req/s drive the REAL
+    HTTP server end to end (POST /v1/generate per request), and each
+    rate reports TTFT / TPOT / queue-wait / e2e p50+p99 with SLO
+    attainment and burn rate — the capacity-vs-SLO curve the autoscaler
+    and disaggregation work will be judged with.
+
+    Method guards:
+
+    * **Open loop.**  Every schedule is fixed before its run (seeded
+      Poisson arrivals, per-tenant prompt/output mixes, shared
+      prefixes); requests fire at their absolute scheduled instant
+      whether or not earlier ones completed — queueing under overload
+      lands in the latencies instead of vanishing into a coordinated-
+      omission feedback loop.
+    * **Steady state.**  Each rate's schedule runs twice UNTIMED first
+      (pass 1 mints every compiled shape and fills the prefix cache;
+      pass 2 reaches the steady-state hit pattern whose continuation
+      buckets the timed pass will use), then once timed.
+    * **Zero recompiles.**  The timed pass runs under
+      ``compile_watch.expect_no_compiles`` — a compile mid-measurement
+      invalidates the row and fails the artifact.
+    * **Server-side truth.**  Latencies come from the request-lifecycle
+      timelines (``SloTracker``), scoped to the timed window; the
+      client-observed e2e and scheduling fidelity (send lag) ride
+      alongside from the load generator.
+    """
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import (
+        Server, SloPolicy, TenantConfig, TenantLoad, poisson_schedule,
+        run_open_loop,
+    )
+    from ml_trainer_tpu.serving.slo import aggregate_timelines
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    model = get_model("gpt2_tiny", max_len=256)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    policy = SloPolicy(ttft_ms=ttft_ms, tpot_ms=tpot_ms, target=0.9)
+    # Production-shaped mix: a heavier "pro" tenant whose requests open
+    # with a shared system prompt (prefix-cache reuse), a lighter fully
+    # unique "free" tenant.
+    load = {
+        "pro": TenantLoad(weight=2.0, prompt_len=(8, 24),
+                          output_len=(4, 16), shared_prefix_len=32,
+                          shared_frac=0.6),
+        "free": TenantLoad(weight=1.0, prompt_len=(8, 24),
+                           output_len=(4, 16)),
+    }
+    tenant_cfg = {"pro": TenantConfig(weight=2.0),
+                  "free": TenantConfig(weight=1.0)}
+    compile_watch.install()
+    rows = []
+    for i, rate in enumerate(rates):
+        schedule = poisson_schedule(
+            float(rate), n_requests, model.vocab_size, tenants=load,
+            seed=seed + i,
+        )
+        with Server(model, variables, max_batch=max_batch,
+                    max_queue=2 * n_requests, kv_page_size=page_size,
+                    tenants=dict(tenant_cfg), slo=policy,
+                    slo_timelines=4 * n_requests) as srv:
+            host, port = srv.serve_http(port=0)
+            url = f"http://{host}:{port}"
+            # Two untimed passes: compiles + prefix cache to steady
+            # state (pass 2's hit pattern == the timed pass's).
+            for _ in range(2):
+                run_open_loop(schedule, url=url, time_scale=0.0)
+            timed_t0 = time.monotonic()
+            err = None
+            try:
+                with compile_watch.expect_no_compiles(f"slo rate {rate}"):
+                    client = run_open_loop(schedule, url=url)
+            except AssertionError as e:
+                err = str(e)
+                client = run_open_loop(schedule, url=url)
+            server_side = aggregate_timelines(
+                srv.slo.timelines(since=timed_t0), policy
+            )
+            snap = srv.metrics.snapshot()
+        client.pop("per_request")
+        row = {
+            "offered_rps": float(rate),
+            "n_requests": n_requests,
+            "tokens_per_sec": client["tokens_per_sec"],
+            "n_errors": client["n_errors"],
+            "client": client,
+            "server": server_side,
+            "prefix_hit_rate": snap["prefix_hit_rate"],
+            "preemptions": snap["preemptions_total"],
+            "zero_recompiles": err is None,
+        }
+        if err is not None:
+            row["recompile_error"] = err
+        rows.append(row)
+        print(
+            f"# slo rate {rate:>6.1f} rps: {row['tokens_per_sec']:,.1f} "
+            f"tokens/s, TTFT p99 {server_side['ttft_ms']['p99']} ms, "
+            f"TPOT p99 {server_side['tpot_ms']['p99']} ms, attainment "
+            f"ttft={server_side['attainment']['ttft']} "
+            f"tpot={server_side['attainment']['tpot']}"
+            + ("" if err is None else "  [RECOMPILED]"),
+            flush=True,
+        )
+    result = {
+        "policy": {"ttft_ms": ttft_ms, "tpot_ms": tpot_ms,
+                   "target": policy.target},
+        "rates": rows,
+        "n_requests_per_rate": n_requests,
+        "max_batch": max_batch,
+        "page_size": page_size,
+        "seed": seed,
+        "zero_recompiles": all(r["zero_recompiles"] for r in rows),
+        "backend": jax.default_backend(),
+    }
+    if not result["zero_recompiles"]:
+        result["error"] = "compiles observed during a timed pass"
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# slo artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_spec(b=2, pattern_len=8, prompt_len=64, new_tokens=128,
                draft_k=8, reps=2, seed=0):
     """Speculative-decoding leg: tokens/s of the speculative loop
@@ -1646,6 +1775,13 @@ def main():
                         "80%%-shared-prefix Poisson trace; writes the "
                         "docs/serving_replay_cpu.json artifact "
                         "(gpt2_tiny; CPU-safe)")
+    parser.add_argument("--slo", action="store_true",
+                        help="run only the open-loop SLO sweep: fixed "
+                        "Poisson arrival schedules at >=3 offered rates "
+                        "through the real HTTP server, TTFT/TPOT/queue-"
+                        "wait/e2e p50+p99 with SLO attainment + burn rate "
+                        "per rate, zero recompiles pinned; writes "
+                        "docs/serving_slo_cpu.json (gpt2_tiny; CPU-safe)")
     parser.add_argument("--mixed", action="store_true",
                         help="run only the mixed-precision / sharded-update "
                         "matrix: {fp32,bf16} x {fused-psum, bucketed "
@@ -1761,6 +1897,20 @@ def main():
         )
         result = bench_serve_replay(out_path=out)
         print(json.dumps({"serve_replay": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.slo:
+        # Open-loop capacity-vs-SLO sweep through the real HTTP server;
+        # the artifact is what scripts/bench_gate.py gate_slo ratchets.
+        import os as _os
+
+        out = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "serving_slo_cpu.json",
+        )
+        result = bench_slo(out_path=out)
+        print(json.dumps({"slo": result}))
         if result.get("error"):
             sys.exit(1)
         return

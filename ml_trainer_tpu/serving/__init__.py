@@ -29,8 +29,21 @@ from ml_trainer_tpu.serving.scheduler import (
     TenantConfig,
     TenantScheduler,
 )
+from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
+from ml_trainer_tpu.serving.loadgen import (
+    TenantLoad,
+    poisson_schedule,
+    run_open_loop,
+    schedule_from_trace,
+)
 
 __all__ = [
+    "SloPolicy",
+    "SloTracker",
+    "TenantLoad",
+    "poisson_schedule",
+    "run_open_loop",
+    "schedule_from_trace",
     "Server",
     "TokenStream",
     "SlotDecodeEngine",

@@ -14,9 +14,11 @@ driving the admit/step loop.  Callers interact through:
   "temperature": t?, "seed": s?, "eos_token_id": e?, "deadline": d?,
   "tenant": name?, "priority": p?}``
   returns ``{"tokens": [...]}``; GET ``/metrics`` serves Prometheus
-  text exposition of the process telemetry registry (serving gauges
-  freshly published — what a scraper points at); GET ``/metrics.json``
-  keeps the flat JSON snapshot shape; GET ``/healthz`` liveness/health
+  text exposition of the process telemetry registry (serving gauges,
+  lifecycle latency histograms and SLO attainment freshly published —
+  what a scraper points at); GET ``/metrics.json``
+  keeps the flat JSON snapshot shape; GET ``/slo`` the structured SLO
+  attainment snapshot; GET ``/healthz`` liveness/health
   (503 when wedged or draining); POST ``/admin/profile``
   ``{"steps": K, "logdir"?: ...}`` arms an on-demand ``jax.profiler``
   window over the next K decode steps (telemetry/spans.py).
@@ -50,6 +52,7 @@ from ml_trainer_tpu.serving.scheduler import (
     TenantScheduler,
     _DONE,
 )
+from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
 from ml_trainer_tpu.utils.logging import get_logger
 
 
@@ -137,7 +140,9 @@ class Server:
                  prefix_cache: bool = True,
                  prefix_scope: str = "tenant",
                  tenants: Optional[dict] = None,
-                 max_preemptions: int = 8):
+                 max_preemptions: int = 8,
+                 slo: Optional[SloPolicy] = None,
+                 slo_timelines: int = 64):
         """``watchdog_timeout``: seconds the engine loop may go without a
         heartbeat WHILE work is pending before the watchdog declares it
         wedged — fails every in-flight/queued request with a structured
@@ -162,8 +167,17 @@ class Server:
 
         ``tenants`` maps tenant name -> :class:`TenantConfig` (weight,
         max_active, max_queued); requests name their tenant at
-        ``submit``.  Unknown tenants get the default config."""
+        ``submit``.  Unknown tenants get the default config.
+
+        ``slo`` sets the :class:`SloPolicy` (TTFT/TPOT budgets + target)
+        the always-on :class:`SloTracker` judges finished requests
+        against (``server.slo`` — attainment/burn-rate on ``/metrics``
+        and the ``/slo`` endpoint); ``slo_timelines`` bounds the
+        last-N request-timeline ring attached to flight dumps."""
         self.metrics = metrics if metrics is not None else ServingMetrics()
+        self.slo = SloTracker(
+            policy=slo, metrics=self.metrics, keep_timelines=slo_timelines,
+        )
         self.engine = SlotDecodeEngine(
             model, variables, max_batch=max_batch, metrics=self.metrics,
             spec_k=spec_k, drafter=drafter, draft_variables=draft_variables,
@@ -174,6 +188,12 @@ class Server:
         self.scheduler = TenantScheduler(
             max_batch, max_queue=max_queue, metrics=self.metrics,
             tenants=tenants,
+        )
+        # Every flight dump (watchdog trip, engine death, preemption
+        # storm) carries the last-N finished request timelines plus the
+        # in-flight ones — the dump names the requests it hurt.
+        self.engine._flight.register_context_provider(
+            "serving_requests", self.slo.context_payload
         )
         self._idle_poll = idle_poll
         self._log = get_logger("ml_trainer_tpu.serving")
@@ -265,7 +285,13 @@ class Server:
             eos_token_id=eos_token_id, deadline=deadline,
             tenant=tenant, priority=int(priority),
         )
+        # Observer installed BEFORE the enqueue so every terminal path —
+        # including queued-expiry inside the scheduler — lands in the
+        # SLO accounting; a rejected submit never enqueues, so its
+        # observer simply never fires.
+        req.observer = self.slo.observe
         self.scheduler.submit(req)
+        self.slo.track(req)
         self._wake.set()
         return TokenStream(req, prompt)
 
@@ -380,6 +406,12 @@ class Server:
             engine_step=self.engine._step_seq,
             active_requests=self.engine.active_count(),
             queued_requests=self.scheduler.queue_depth(),
+            # The dump NAMES the requests the wedge/death hurt; their
+            # full lifecycle timelines ride in the serving_requests
+            # context provider (SloTracker.context_payload).
+            active_request_ids=[
+                req.id for req in self.engine._active.values()
+            ],
         )
         self._fail_all(f"serving engine unhealthy: {reason}",
                        release_slots=False)
@@ -545,12 +577,18 @@ class Server:
 
                     registry = default_registry()
                     server.metrics.publish(registry)
+                    server.slo.publish(registry)
                     self._send_text(
                         200, registry.prometheus_text(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 elif self.path == "/metrics.json":
                     self._send(200, server.metrics.snapshot())
+                elif self.path == "/slo":
+                    # Structured SLO attainment (policy, per-tenant
+                    # attainment + burn rate) — the JSON twin of the
+                    # serving_slo_* series on /metrics.
+                    self._send(200, server.slo.snapshot())
                 else:
                     self._send(404, {"error": "not found"})
 

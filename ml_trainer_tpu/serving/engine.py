@@ -592,6 +592,7 @@ class SlotDecodeEngine:
         has been preempted ``max_preemptions`` times."""
         req = self._active.pop(slot)
         req.preemptions += 1
+        req.mark("preempt", slot=slot, cause=cause)
         self._flight.record(
             "preempt", request=req.id, tenant=req.tenant, slot=slot,
             committed_tokens=len(req.tokens),
@@ -736,6 +737,11 @@ class SlotDecodeEngine:
 
         req.slot = slot
         req.state = "active"
+        req.mark(
+            "prefill_start", slot=slot,
+            kind="continuation" if (self.paged and c > 0) else "full",
+            prefix_hit_tokens=c, resumed_tokens=done_tokens,
+        )
         t0 = time.perf_counter()
         if self.paged and c > 0:
             tok0 = self._admit_paged_continuation(
@@ -754,7 +760,10 @@ class SlotDecodeEngine:
                 self._admit_draft(prompt, slot, key, req.temperature)
         self._pos[slot] = p
         tok0 = np.asarray(tok0)  # blocks until prefill + insert land
-        self.metrics.record_prefill(time.perf_counter() - t0)
+        prefill_dt = time.perf_counter() - t0
+        req.prefill_secs += prefill_dt
+        req.mark("prefill_done", ms=round(prefill_dt * 1e3, 3))
+        self.metrics.record_prefill(prefill_dt)
         self._temps[slot] = req.temperature
         self._rngs[slot] = key
         self._steps[slot] = done_tokens + 1
@@ -773,7 +782,17 @@ class SlotDecodeEngine:
         token = int(tok0.reshape(-1)[0])
         req.push_token(token)
         if done_tokens == 0:
-            self.metrics.record_ttft(time.monotonic() - req.submitted_at)
+            self.metrics.record_ttft(
+                time.monotonic() - req.submitted_at, tenant=req.tenant
+            )
+            if req.first_admitted_at is not None:
+                # The queueing half of TTFT (the prefill-compute half is
+                # record_prefill above), per-request, so a saturated
+                # queue and a slow prefill are attributable apart.
+                self.metrics.record_queue_wait(
+                    req.first_admitted_at - req.submitted_at,
+                    tenant=req.tenant,
+                )
         self._active[slot] = req
         if self._finished(req, token):
             return "finished"
@@ -791,7 +810,8 @@ class SlotDecodeEngine:
             ("serve_prefill", self.model, bucket),
             lambda: self._build_prefill(bucket),
         )
-        with span("serve_prefill", prompt_len=p, bucket=bucket, slot=slot):
+        with span("serve_prefill", prompt_len=p, bucket=bucket, slot=slot,
+                  request=req.id, tenant=req.tenant):
             cache1, tok0 = run(
                 self.params, padded, np.int32(p),
                 jnp.asarray(req.temperature, jnp.float32), key,
@@ -834,7 +854,8 @@ class SlotDecodeEngine:
             lambda: self._build_prefill_paged(bucket),
         )
         with span("serve_prefill_paged", prompt_len=p, prefix_hit=c,
-                  bucket=bucket, slot=slot):
+                  bucket=bucket, slot=slot, request=req.id,
+                  tenant=req.tenant):
             self.cache, self.tok, tok0 = run(
                 self.cache, self.tok, self.params, padded, np.int32(su),
                 np.int32(c), jnp.asarray(self._page_row(slot)),
@@ -887,11 +908,13 @@ class SlotDecodeEngine:
             return []
         self._step_seq += 1
         # Flight record BEFORE the dispatch: when this step wedges, the
-        # ring's newest decode_step record names the step the watchdog
-        # dump blames.
+        # ring's newest decode_step record names the step — and the
+        # REQUESTS riding it — that the watchdog dump blames.
+        step_requests = [req.id for _, req in sorted(self._active.items())]
         self._flight.record(
             "decode_step", engine_step=self._step_seq,
             active=len(self._active), spec=bool(self.spec_k),
+            requests=step_requests,
         )
         self._profiler.on_step(self._step_seq)
         # decode_wedge injection hook (resilience/faults.py): block like a
@@ -917,7 +940,7 @@ class SlotDecodeEngine:
         active_before = len(self._active)
         t0 = time.perf_counter()
         with span("serve_decode", engine_step=self._step_seq,
-                  active=active_before):
+                  active=active_before, requests=step_requests):
             self.cache, self.tok = self._decode(
                 self.params, self.cache, self.tok,
                 self._temps, self._rngs, self._steps,
@@ -963,9 +986,10 @@ class SlotDecodeEngine:
         than the vanilla per-token fold)."""
         active_before = len(self._active)
         k = self.spec_k
+        step_requests = [req.id for _, req in sorted(self._active.items())]
         t0 = time.perf_counter()
         with span("serve_decode_spec", engine_step=self._step_seq,
-                  active=active_before, k=k):
+                  active=active_before, k=k, requests=step_requests):
             if self._draft is not None:
                 self._draft_cache, drafts_dev = self._draft_scan(
                     self._draft.params, self._draft_cache, self.tok,

@@ -51,6 +51,28 @@ def _percentile(sorted_vals, q: float) -> float:
 # registration requires.
 SPEC_ACCEPT_BUCKETS = tuple(float(i) for i in range(17))
 
+# Latency histogram buckets (seconds) for the request-lifecycle
+# distributions (TTFT / TPOT / queue-wait / end-to-end).  Sub-ms floor
+# for a warm CPU decode tick, 60s ceiling for a cold-compile TTFT;
+# FIXED so idempotent registration holds across servers in one process.
+LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+# The lifecycle latency histograms ``publish()`` maintains: snapshot
+# field stem -> registry metric name.  Observations are queued by the
+# ``record_*`` sites and DRAINED into the histograms at publish (the
+# ``serving_spec_accept`` delta pattern: snapshots are point-in-time,
+# histogram observations are not, so repeated scrapes never
+# double-count).
+LATENCY_HISTOGRAMS = {
+    "ttft": "serving_ttft_seconds",
+    "tpot": "serving_tpot_seconds",
+    "queue_wait": "serving_queue_wait_seconds",
+    "e2e": "serving_e2e_seconds",
+}
+
 
 class ServingMetrics:
     """Thread-safe rolling serving metrics (bounded windows)."""
@@ -66,6 +88,14 @@ class ServingMetrics:
         self._prefill_secs = collections.deque(maxlen=window)
         self._step_secs = collections.deque(maxlen=window)
         self._occupancy = collections.deque(maxlen=window)
+        # Request-lifecycle latency windows (snapshot percentiles) and
+        # the publish-drained histogram queues: each entry is
+        # ``(seconds, tenant)`` awaiting its one observation into the
+        # registry histogram named in LATENCY_HISTOGRAMS.
+        self._queue_wait = collections.deque(maxlen=window)
+        self._tpot = collections.deque(maxlen=window)
+        self._e2e = collections.deque(maxlen=window)
+        self._hist_pending: dict = {k: [] for k in LATENCY_HISTOGRAMS}
         self.tokens_total = 0
         self.steps_total = 0
         self.busy_secs = 0.0
@@ -116,9 +146,42 @@ class ServingMetrics:
 
     # -- recording -------------------------------------------------------
 
-    def record_ttft(self, seconds: float) -> None:
+    def record_ttft(self, seconds: float,
+                    tenant: Optional[str] = None) -> None:
         with self._lock:
             self._ttft.append(float(seconds))
+            self._hist_pending["ttft"].append(
+                (float(seconds), tenant or "default")
+            )
+
+    def record_queue_wait(self, seconds: float,
+                          tenant: Optional[str] = None) -> None:
+        """Submit -> first admission: the queueing half of TTFT (the
+        other half is prefill compute), so saturation is attributable."""
+        with self._lock:
+            self._queue_wait.append(float(seconds))
+            self._hist_pending["queue_wait"].append(
+                (float(seconds), tenant or "default")
+            )
+
+    def record_tpot(self, deltas, tenant: Optional[str] = None) -> None:
+        """Inter-token latencies (seconds) of one finished request —
+        the client-observed time-per-output-token distribution."""
+        with self._lock:
+            for d in deltas:
+                self._tpot.append(float(d))
+                self._hist_pending["tpot"].append(
+                    (float(d), tenant or "default")
+                )
+
+    def record_e2e(self, seconds: float,
+                   tenant: Optional[str] = None) -> None:
+        """Submit -> finish wall latency of one completed request."""
+        with self._lock:
+            self._e2e.append(float(seconds))
+            self._hist_pending["e2e"].append(
+                (float(seconds), tenant or "default")
+            )
 
     def record_prefill(self, seconds: float, tokens: int = 1) -> None:
         """One out-of-band prefill: its latency counts as busy time and
@@ -262,6 +325,10 @@ class ServingMetrics:
                 sum(self._occupancy) / len(self._occupancy)
                 if self._occupancy else 0.0
             )
+            prefill = sorted(self._prefill_secs)
+            queue_wait = sorted(self._queue_wait)
+            tpot = sorted(self._tpot)
+            e2e = sorted(self._e2e)
             return {
                 "ttft_p50_ms": round(_percentile(ttft, 0.5) * 1e3, 3),
                 "ttft_p99_ms": round(_percentile(ttft, 0.99) * 1e3, 3),
@@ -272,8 +339,24 @@ class ServingMetrics:
                     _percentile(steps, 0.99) * 1e3, 3
                 ),
                 "prefill_p50_ms": round(
-                    _percentile(sorted(self._prefill_secs), 0.5) * 1e3, 3
+                    _percentile(prefill, 0.5) * 1e3, 3
                 ),
+                # TTFT decomposition: submit->admit queue wait vs the
+                # admit->first-token prefill compute, so a saturated
+                # queue and a slow prefill read differently.
+                "prefill_p99_ms": round(
+                    _percentile(prefill, 0.99) * 1e3, 3
+                ),
+                "queue_wait_p50_ms": round(
+                    _percentile(queue_wait, 0.5) * 1e3, 3
+                ),
+                "queue_wait_p99_ms": round(
+                    _percentile(queue_wait, 0.99) * 1e3, 3
+                ),
+                "tpot_p50_ms": round(_percentile(tpot, 0.5) * 1e3, 3),
+                "tpot_p99_ms": round(_percentile(tpot, 0.99) * 1e3, 3),
+                "e2e_p50_ms": round(_percentile(e2e, 0.5) * 1e3, 3),
+                "e2e_p99_ms": round(_percentile(e2e, 0.99) * 1e3, 3),
                 "tokens_total": self.tokens_total,
                 "decode_steps_total": self.steps_total,
                 "tokens_per_sec_busy": round(
@@ -362,6 +445,27 @@ class ServingMetrics:
 
         r = registry if registry is not None else default_registry()
         snap = self.snapshot()
+        # Request-lifecycle latency histograms (TTFT / TPOT / queue-wait
+        # / e2e): drain the pending observations queued by record_* into
+        # the registry's REAL Histogram type — proper cumulative
+        # ``_bucket{le=...}`` exposition, per-tenant labels, and
+        # publish() stays idempotent under repeated scrapes (each
+        # observation is consumed exactly once).
+        with self._lock:
+            drained = {
+                k: v for k, v in self._hist_pending.items() if v
+            }
+            for k in drained:
+                self._hist_pending[k] = []
+        for stem, obs in drained.items():
+            h = r.histogram(
+                LATENCY_HISTOGRAMS[stem],
+                f"request {stem} latency (seconds)",
+                labelnames=("tenant",),
+                buckets=LATENCY_BUCKETS,
+            )
+            for seconds, tenant in obs:
+                h.labels(tenant=tenant).observe(seconds)
         for key, value in snap.items():
             if key == "tenants":
                 # Per-tenant ledger -> labeled serving_tenant_* gauges

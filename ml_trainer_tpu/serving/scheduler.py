@@ -148,6 +148,18 @@ class Request:
     error: Optional[str] = None
     first_token_at: Optional[float] = None
     finished_at: Optional[float] = None
+    # Lifecycle tracing (docs/observability.md "Serving SLO"): monotonic
+    # stamp of every pushed token (TPOT = consecutive deltas), the
+    # ordered lifecycle event list (``mark()``), the FIRST admission
+    # stamp (queue-wait; ``admitted_at`` is overwritten on a
+    # preempt-resume), measured prefill compute seconds, and an optional
+    # finish observer (the server's SloTracker) called exactly once.
+    token_times: list = field(default_factory=list)
+    events: list = field(default_factory=list)
+    first_admitted_at: Optional[float] = None
+    prefill_secs: float = 0.0
+    observer: object = None
+    _observed: bool = False
     _stream: _queue.Queue = field(default_factory=_queue.Queue)
 
     @property
@@ -162,17 +174,108 @@ class Request:
 
     # -- engine-side hand-off -------------------------------------------
 
+    def mark(self, event: str, **extra) -> None:
+        """Append one lifecycle event to the request's timeline (the
+        single-writer engine/scheduler hand-off points call this; the
+        list is only read after ``finish`` or copied defensively)."""
+        row = {"t": round(time.monotonic(), 6), "event": event}
+        if extra:
+            row.update(extra)
+        self.events.append(row)
+
     def push_token(self, token: int) -> None:
+        now = time.monotonic()
         self.tokens.append(int(token))
+        self.token_times.append(now)
         if self.first_token_at is None:
-            self.first_token_at = time.monotonic()
+            self.first_token_at = now
         self._stream.put(int(token))
 
     def finish(self, state: str = "done", error: Optional[str] = None) -> None:
         self.state = state
         self.error = error
         self.finished_at = time.monotonic()
+        self.mark("finish", state=state)
+        # Observer BEFORE the stream sentinel: when result() returns,
+        # the request's timeline is already in the SLO accounting.
+        obs = self.observer
+        if obs is not None and not self._observed:
+            self._observed = True
+            try:
+                obs(self)
+            except Exception:  # accounting must never block completion
+                pass
         self._stream.put(_DONE)
+
+    # -- timeline --------------------------------------------------------
+
+    def tpot_deltas(self) -> list:
+        """Inter-token latencies in seconds (client-observed TPOT): the
+        gaps between consecutive pushed tokens.  Empty for <2 tokens."""
+        tt = list(self.token_times)
+        return [tt[i] - tt[i - 1] for i in range(1, len(tt))]
+
+    def timeline(self) -> dict:
+        """The structured per-request lifecycle record (JSON-safe): what
+        the SLO tracker aggregates, the flight recorder attaches to
+        dumps, and the trace emitter renders as nested spans.  Safe to
+        call on an in-flight request (defensive copies; derived fields
+        are None until their anchor events exist)."""
+        first_admit = self.first_admitted_at
+        ttft = (
+            self.first_token_at - self.submitted_at
+            if self.first_token_at is not None else None
+        )
+        queue_wait = (
+            first_admit - self.submitted_at
+            if first_admit is not None else None
+        )
+        e2e = (
+            self.finished_at - self.submitted_at
+            if self.finished_at is not None else None
+        )
+        deltas = self.tpot_deltas()
+        deltas.sort()
+
+        def _pct(q):
+            if not deltas:
+                return None
+            i = min(len(deltas) - 1, int(q * (len(deltas) - 1) + 0.5))
+            return round(deltas[i] * 1e3, 3)
+
+        def _ms(v):
+            return round(v * 1e3, 3) if v is not None else None
+
+        return {
+            "id": self.id,
+            "tenant": self.tenant,
+            "priority": self.priority,
+            "state": self.state,
+            "prompt_tokens": int(np.asarray(self.prompt).size),
+            "new_tokens": len(self.tokens),
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "preemptions": self.preemptions,
+            "submitted_at": round(self.submitted_at, 6),
+            "finished_at": (
+                round(self.finished_at, 6)
+                if self.finished_at is not None else None
+            ),
+            "queue_wait_ms": _ms(queue_wait),
+            "prefill_ms": _ms(self.prefill_secs) if self.prefill_secs
+            else 0.0,
+            "ttft_ms": _ms(ttft),
+            "e2e_ms": _ms(e2e),
+            "tpot_ms": {
+                "mean": round(
+                    sum(deltas) / len(deltas) * 1e3, 3
+                ) if deltas else None,
+                "p50": _pct(0.5),
+                "p99": _pct(0.99),
+                "max": round(deltas[-1] * 1e3, 3) if deltas else None,
+            },
+            "error": self.error,
+            "events": [dict(e) for e in list(self.events)],
+        }
 
 
 class TenantScheduler:
@@ -233,6 +336,7 @@ class TenantScheduler:
                     f"({cfg.max_queued}); request {req.id} rejected"
                 )
             self._enqueue(req, q)
+            req.mark("queued", tenant_depth=len(q))
             if self._metrics is not None:
                 self._metrics.record_admission(
                     self._total_queued, req.tenant, len(q)
@@ -245,6 +349,7 @@ class TenantScheduler:
         already admitted once and its client is still streaming."""
         req.state = "queued"
         req.slot = -1
+        req.mark("requeued", preemptions=req.preemptions)
         with self._lock:
             self._enqueue(req, self._queues.setdefault(req.tenant, []))
 
@@ -330,6 +435,9 @@ class TenantScheduler:
                 req.slot = slot
                 req.state = "active"
                 req.admitted_at = time.monotonic()
+                if req.first_admitted_at is None:
+                    req.first_admitted_at = req.admitted_at
+                req.mark("admitted", slot=slot)
                 return req, slot
             return None
 

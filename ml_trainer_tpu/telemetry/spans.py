@@ -40,8 +40,12 @@ from ml_trainer_tpu.utils.profiler import annotate
 logger = get_logger("ml_trainer_tpu.telemetry")
 
 # Trace clock: microseconds since process start (Chrome wants µs; a
-# perf_counter epoch keeps values small and monotonic).
+# perf_counter epoch keeps values small and monotonic).  The monotonic
+# epoch is captured in the same instant so timestamps recorded with
+# ``time.monotonic()`` elsewhere (request lifecycle stamps — the
+# deadline clock) can be converted onto the trace timeline.
 _EPOCH = time.perf_counter()
+_MONO_EPOCH = time.monotonic()
 
 _MAX_EVENTS = 100_000
 _events: collections.deque = collections.deque(maxlen=_MAX_EVENTS)
@@ -76,6 +80,30 @@ def span(name: str, category: str = "host", **args):
                 ev["args"] = args
             with _events_lock:
                 _events.append(ev)
+
+
+def complete_event(name: str, start_mono: float, end_mono: float,
+                   category: str = "host", **args) -> None:
+    """Record a RETROSPECTIVE complete event from ``time.monotonic()``
+    stamps — how a request's lifecycle (submit → queue → prefill →
+    decode → finish), known only once it ends, lands on the trace
+    timeline as properly nested spans.  Events emitted from one thread
+    with containing timestamps nest in Perfetto exactly like live
+    ``span()`` regions."""
+    t0 = (start_mono - _MONO_EPOCH) * 1e6
+    ev = {
+        "name": name,
+        "cat": category,
+        "ph": "X",
+        "ts": t0,
+        "dur": max((end_mono - start_mono) * 1e6, 0.0),
+        "pid": os.getpid(),
+        "tid": threading.get_ident(),
+    }
+    if args:
+        ev["args"] = args
+    with _events_lock:
+        _events.append(ev)
 
 
 def instant(name: str, category: str = "event", **args) -> None:

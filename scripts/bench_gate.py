@@ -46,6 +46,13 @@ floor, and the 1F1B steps/s ratchets against the committed
 ``docs/pipeline_schedules_cpu.json`` artifact / this machine's
 baseline.
 
+A fifth leg (``gate_slo``, skip with ``--skip-slo``) gates the serving
+SLO harness: a short open-loop Poisson run through the real HTTP server
+at the committed artifact's highest offered rate — zero recompiles and
+zero client errors are hard invariants, attainment must be computed
+over every request, and the sustained tokens/s at that rate ratchets
+against ``docs/serving_slo_cpu.json`` / this machine's baseline.
+
 A sixth leg (``gate_lint``, skip with ``--skip-lint``) gates the
 graft-lint static analysis: the jaxpr contract checks over the traced
 train/decode/pipeline programs and the AST concurrency/hygiene pack
@@ -457,6 +464,102 @@ def gate_pipeline(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_slo_reference(repo: str = REPO):
+    """(highest offered rate, its tokens/s) from the committed SLO sweep
+    artifact (docs/serving_slo_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "serving_slo_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    rows = [
+        r for r in data.get("rates", [])
+        if isinstance(r.get("offered_rps"), (int, float))
+        and isinstance(r.get("tokens_per_sec"), (int, float))
+    ]
+    if not rows:
+        return None
+    top = max(rows, key=lambda r: r["offered_rps"])
+    return float(top["offered_rps"]), float(top["tokens_per_sec"]), data
+
+
+def gate_slo(threshold: float, backend: str, fp: str) -> dict:
+    """The serving-SLO regression gate: a short open-loop Poisson run
+    through the real HTTP server at the committed artifact's highest
+    offered rate, gated three ways —
+
+    1. **Invariants** (hard): zero recompiles during the timed pass
+       (compile_watch-pinned inside ``bench_slo``), zero client errors,
+       and SLO attainment computed over every scheduled request.
+    2. **Attainment sanity** (machine-independent): TPOT attainment at
+       the saturating rate must stay positive — a zero means decode
+       ticks themselves blew the budget, which is a throughput
+       collapse, not queueing.
+    3. **Trajectory/local baseline** on the sustained tokens/s at the
+       highest rate, with the same calibrate-then-ratchet fallback the
+       parity gate uses.
+    """
+    import bench
+
+    committed = committed_slo_reference()
+    top_rate = committed[0] if committed else 720.0
+    result = bench.bench_slo(rates=(top_rate,), n_requests=24)
+    row = result["rates"][0]
+    server = row["server"]
+    out = {
+        "offered_rps": row["offered_rps"],
+        "tokens_per_sec": row["tokens_per_sec"],
+        "ttft_p99_ms": server["ttft_ms"]["p99"],
+        "tpot_p99_ms": server["tpot_ms"]["p99"],
+        "attainment": server["attainment"],
+        "threshold": threshold,
+    }
+    if not row["zero_recompiles"]:
+        out.update(ok=False, decided_by="zero_recompile",
+                   error="compiles observed during the timed SLO pass: "
+                   + str(row.get("recompile_error")))
+        return out
+    if row["n_errors"]:
+        out.update(ok=False, decided_by="client_errors",
+                   error=f"{row['n_errors']} client error(s): "
+                   + "; ".join(row["client"]["errors"]))
+        return out
+    if server["n_requests"] < row["n_requests"]:
+        out.update(
+            ok=False, decided_by="attainment_coverage",
+            error=f"attainment computed over {server['n_requests']} of "
+            f"{row['n_requests']} requests",
+        )
+        return out
+    if server["attainment"]["tpot"] <= 0.0:
+        out.update(
+            ok=False, decided_by="tpot_collapse",
+            error="TPOT attainment 0 at the saturating rate — decode "
+            "ticks themselves blow the budget",
+        )
+        return out
+    slo_key = f"{backend}_serve_slo"
+    baseline = load_baseline(slo_key, fp)
+    decision = evaluate(
+        float(row["tokens_per_sec"]),
+        committed[1] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            slo_key, fp, max(float(row["tokens_per_sec"]), baseline or 0.0),
+        )
+    elif "error" not in out:
+        out["error"] = (
+            f"slo sweep {row['tokens_per_sec']} tokens/s at "
+            f"{top_rate} rps is >{threshold * 100:.0f}% below this "
+            f"machine's baseline {baseline}"
+        )
+    return out
+
+
 def committed_goodput_reference(repo: str = REPO):
     """The committed memory/goodput artifact
     (docs/memory_goodput_cpu.json), or None."""
@@ -638,6 +741,8 @@ def main() -> int:
                         "gate")
     parser.add_argument("--skip-pipeline", action="store_true",
                         help="skip the pipeline-schedule gate")
+    parser.add_argument("--skip-slo", action="store_true",
+                        help="skip the serving-SLO open-loop gate")
     parser.add_argument("--skip-goodput", action="store_true",
                         help="skip the memory-ledger / goodput / "
                         "recompile gate")
@@ -723,6 +828,19 @@ def main() -> int:
             f"BENCH_GATE PIPELINE OK ({pipe['decided_by']}): 1f1b at "
             f"{pipe['gpipe_over_1f1b_s4_m8']}x gpipe step rate "
             f"(S=4/M=8), {pipe.get('f1b_steps_per_sec')} steps/s",
+            flush=True,
+        )
+    if not args.skip_slo:
+        slo = gate_slo(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_slo": slo}), flush=True)
+        if not slo["ok"]:
+            print(f"BENCH_GATE SLO FAIL: {slo.get('error')}", flush=True)
+            return 1
+        print(
+            f"BENCH_GATE SLO OK ({slo['decided_by']}): "
+            f"{slo['tokens_per_sec']} tokens/s at {slo['offered_rps']} "
+            f"rps, TTFT p99 {slo['ttft_p99_ms']} ms, attainment "
+            f"{slo['attainment']}",
             flush=True,
         )
     if not args.skip_goodput:

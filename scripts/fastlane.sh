@@ -18,7 +18,11 @@
 # goodput / recompile smoke leg (scripts/memory_smoke.py: analytic HBM
 # ledger within 10% of measured state bytes on pure-DP / ZeRO-1 /
 # pipeline configs, goodput bucket arithmetic, zero post-warmup
-# compiles), and a bench
+# compiles), a serving-SLO smoke leg (scripts/slo_smoke.py: open-loop
+# Poisson schedule through the real HTTP server — lifecycle latency
+# histograms + attainment/burn-rate exposition, nested request trace
+# spans, forced-preemption flight dump naming request ids with
+# timelines), and a bench
 # graft-lint static-analysis leg (scripts/graft_lint.py: jaxpr
 # contract checks over the traced train/decode/pipeline programs +
 # the AST concurrency/hygiene pack, hard-failed against the committed
@@ -31,9 +35,12 @@
 # identity, zero-recompile, paged-vs-contiguous ratio, tokens/s ratchet
 # vs docs/serving_replay_cpu.json), the mixed gate (finite/zero-recompile
 # invariants, sharded>=fused floor, ratchet vs
-# docs/mixed_precision_cpu.json), and the pipeline gate (trajectory
+# docs/mixed_precision_cpu.json), the pipeline gate (trajectory
 # equality + zero-recompile invariants, 1f1b>=gpipe floor at S=4/M=8,
-# ratchet vs docs/pipeline_schedules_cpu.json).
+# ratchet vs docs/pipeline_schedules_cpu.json), and the serving-SLO
+# gate (zero-recompile + zero-error invariants at the committed
+# artifact's highest offered rate, tokens/s ratchet vs
+# docs/serving_slo_cpu.json).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -70,6 +77,10 @@ echo "# memory ledger / goodput / recompile smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py
 memory_rc=$?
 [ $memory_rc -ne 0 ] && echo "# memory smoke FAILED (rc=$memory_rc)"
+echo "# serving-SLO smoke leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+slo_rc=$?
+[ $slo_rc -ne 0 ] && echo "# slo smoke FAILED (rc=$slo_rc)"
 echo "# graft-lint static-analysis leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/graft_lint.py
 lint_rc=$?
@@ -85,7 +96,7 @@ else
   ruff_rc=0
 fi
 echo "# bench regression gate"
-timeout -k 10 1200 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 1500 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -95,6 +106,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$mixed_rc
 [ $rc -eq 0 ] && rc=$pipeline_rc
 [ $rc -eq 0 ] && rc=$memory_rc
+[ $rc -eq 0 ] && rc=$slo_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc
 [ $rc -eq 0 ] && rc=$gate_rc

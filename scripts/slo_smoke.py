@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Serving-SLO smoke leg (scripts/fastlane.sh) — ~60s on CPU.
+
+One short end-to-end pass over the request-lifecycle tracing + SLO
+telemetry + open-loop load harness, through the REAL HTTP server:
+
+1. **Open loop through HTTP.**  A seeded Poisson schedule drives POST
+   ``/v1/generate``; every request completes, the scheduled arrivals
+   fire faithfully.
+2. **Histograms + attainment.**  ``/metrics`` exposes the lifecycle
+   latency histograms (``serving_ttft_seconds_bucket{le=...}`` with a
+   non-zero ``_count``) and the ``serving_slo_attainment`` /
+   ``serving_slo_burn_rate`` series; ``/slo`` returns the structured
+   attainment snapshot.
+3. **Trace nesting.**  Each finished request lands on the span trace as
+   a ``request N`` complete event whose queue_wait / prefill / decode
+   children nest by time containment.
+4. **Preemption forensics.**  A pool too small for two long generations
+   forces a preemption under load; the flight dump NAMES the affected
+   request ids (ring ``preempt`` events + ``active_request_ids``) with
+   their lifecycle timelines attached (``context.serving_requests``).
+
+Exits non-zero (with a reason) on any violation.
+"""
+
+import json
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"SLO_SMOKE FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    import jax
+
+    from ml_trainer_tpu.serving import (
+        Server, SloPolicy, TenantLoad, poisson_schedule, run_open_loop,
+    )
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.telemetry import spans
+    from ml_trainer_tpu.telemetry.flight import get_recorder
+    from ml_trainer_tpu.telemetry.registry import MetricsRegistry
+
+    model = get_model("gpt2_tiny", max_len=64)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+
+    # 1+2+3: open-loop Poisson schedule through the real HTTP server.
+    schedule = poisson_schedule(
+        rate_rps=20.0, n_requests=10, vocab_size=model.vocab_size,
+        tenants={"pro": TenantLoad(weight=2.0, prompt_len=(6, 12),
+                                   output_len=(3, 6)),
+                 "free": TenantLoad(prompt_len=(6, 12),
+                                    output_len=(3, 6))},
+        seed=0,
+    )
+    spans.clear_trace()
+    with Server(model, variables, max_batch=4, max_queue=32,
+                slo=SloPolicy(ttft_ms=5000.0, tpot_ms=5000.0)) as srv:
+        host, port = srv.serve_http(port=0)
+        url = f"http://{host}:{port}"
+        report = run_open_loop(schedule, url=url, timeout=300)
+        with urllib.request.urlopen(f"{url}/metrics", timeout=30) as resp:
+            prom = resp.read().decode()
+        with urllib.request.urlopen(f"{url}/slo", timeout=30) as resp:
+            slo = json.loads(resp.read())
+    if report["n_completed"] != len(schedule) or report["n_errors"]:
+        return fail(
+            f"open loop incomplete: {report['n_completed']}/"
+            f"{len(schedule)} done, errors {report['errors']}"
+        )
+    if 'serving_ttft_seconds_bucket{tenant="pro",le="0.001"}' not in prom:
+        return fail("TTFT histogram bucket exposition missing from /metrics")
+    for name in ("serving_ttft_seconds", "serving_tpot_seconds",
+                 "serving_queue_wait_seconds", "serving_e2e_seconds"):
+        if f"# TYPE {name} histogram" not in prom:
+            return fail(f"{name} missing from /metrics")
+    if 'serving_slo_attainment{slo="ttft",tenant="all"}' not in prom \
+            or "serving_slo_burn_rate" not in prom:
+        return fail("SLO attainment/burn-rate series missing from /metrics")
+    if slo["requests_observed"] != len(schedule):
+        return fail(
+            f"/slo observed {slo['requests_observed']} of {len(schedule)}"
+        )
+    if not (0.0 <= slo["attainment"]["ttft"] <= 1.0):
+        return fail(f"attainment out of range: {slo['attainment']}")
+    evs = spans.trace_events()
+    req_spans = {
+        e["args"]["request"]: e for e in evs
+        if e["name"].startswith("request ") and "args" in e
+    }
+    if len(req_spans) < len(schedule):
+        return fail(
+            f"{len(req_spans)} request spans for {len(schedule)} requests"
+        )
+    kids = [
+        e for e in evs
+        if e["name"] in ("queue_wait", "prefill", "decode")
+        and e.get("args", {}).get("request") in req_spans
+    ]
+    if len(kids) < 2 * len(schedule):
+        return fail(f"only {len(kids)} lifecycle child spans recorded")
+    for k in kids:
+        parent = req_spans[k["args"]["request"]]
+        if not (parent["ts"] - 1 <= k["ts"]
+                and k["ts"] + k["dur"] <= parent["ts"] + parent["dur"] + 1):
+            return fail(
+                f"span {k['name']} of request {k['args']['request']} "
+                "does not nest inside its request span"
+            )
+    print(f"# slo smoke: {report['n_completed']} requests, attainment "
+          f"{slo['attainment']}, {len(kids)} nested lifecycle spans")
+
+    # 4: forced preemption under load -> flight dump names the requests.
+    rng = np.random.default_rng(1)
+    p1 = rng.integers(0, 1024, 9).astype(np.int32)
+    p2 = rng.integers(0, 1024, 11).astype(np.int32)
+    get_recorder().clear()
+    with Server(model, variables, max_batch=2, kv_page_size=8,
+                kv_pages=13, prefix_cache=False) as srv:
+        s1 = srv.submit(p1, 40, tenant="victim")
+        s2 = srv.submit(p2, 40, tenant="victim")
+        s1.result(timeout=300)
+        s2.result(timeout=300)
+        snap = srv.metrics.snapshot()
+        dump_path = get_recorder().dump("slo_smoke forced preemption")
+    if snap["preemptions_total"] < 1:
+        return fail("tight pool produced no preemption")
+    if not dump_path:
+        return fail("flight dump failed to write")
+    with open(dump_path, encoding="utf-8") as fp:
+        dump = json.load(fp)
+    preempts = [r for r in dump["records"] if r["kind"] == "preempt"]
+    if not preempts or "request" not in preempts[0]:
+        return fail(f"preempt record misses request id: {preempts[:1]}")
+    hurt = preempts[0]["request"]
+    ctx = dump.get("context", {}).get("serving_requests", {})
+    tl = next(
+        (t for t in ctx.get("recent", []) + ctx.get("active", [])
+         if t.get("id") == hurt), None,
+    )
+    if tl is None:
+        return fail(
+            f"request {hurt} timeline missing from dump context "
+            f"({len(ctx.get('recent', []))} recent)"
+        )
+    if not any(e.get("event") == "preempt" for e in tl.get("events", [])):
+        return fail(f"timeline of request {hurt} lacks its preempt event")
+    reg = MetricsRegistry()
+    srv.metrics.publish(reg)
+    if "serving_preemptions_total" not in reg.prometheus_text():
+        return fail("preemption counter missing from exposition")
+    os.remove(dump_path)
+    print(f"# slo smoke: preemption dump names request {hurt} with "
+          f"{len(tl['events'])} lifecycle events")
+    print("SLO_SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
