@@ -1138,6 +1138,71 @@ def bench_chaos(size=2048, batch_size=32, save_every=8, preempt_step=41,
             shutil.rmtree(d, ignore_errors=True)
 
 
+def bench_elastic():
+    """Elastic chaos leg: drive ``scripts/elastic_smoke.py`` (its phases
+    need their own processes for per-phase virtual device counts) and
+    distill the numbers a preemptible-fleet operator budgets around:
+
+    * the in-process drain→reshape→continue downtime and steps-lost
+      (clean-drain path: a ``host_kill`` fault drops 1 of 2 simulated
+      hosts, 8 -> 4 devices, same ``fit()`` call finishes with the
+      uninterrupted trajectory);
+    * the hard-kill restart path: a real 2-process cluster loses a host
+      to ``os._exit`` with NO emergency checkpoint, and the restart at
+      a different topology is bounded by the ``save_every_steps``
+      cadence — ``time_to_recover_secs`` is its wall-clock.
+
+    The committed ``docs/elastic_chaos_cpu.json`` pins these; the
+    fastlane gate (``scripts/bench_gate.py gate_elastic``) hard-fails
+    the invariants and ratchets the recovery rate.
+    """
+    import os
+    import subprocess
+    import sys
+
+    script = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "scripts",
+        "elastic_smoke.py",
+    )
+    proc = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True,
+        timeout=500, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    line = next(
+        (ln for ln in proc.stdout.splitlines()
+         if ln.startswith("ELASTIC_SMOKE_RESULT ")), None,
+    )
+    if proc.returncode != 0 or line is None:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-8:]
+        return {"ok": False, "error": " | ".join(tail)}
+    result = json.loads(line[len("ELASTIC_SMOKE_RESULT "):])
+    ip, rs = result["in_process"], result.get("restart", {})
+    out = {
+        "ok": result["ok"],
+        "reshape_downtime_secs": ip["reshape_downtime_secs"],
+        "steps_lost_clean_drain": ip["steps_lost"],
+        "trajectory_equal": ip["trajectory_equal"],
+        "bit_exact_resumable": ip["bit_exact_resumable"],
+        "old_topology": ip["old_topology"],
+        "new_topology": ip["new_topology"],
+        "backend": jax.default_backend(),
+    }
+    if rs:
+        out.update(
+            steps_lost_hard_kill=rs["steps_lost"],
+            steps_lost_bound=rs["steps_lost_bound"],
+            time_to_recover_secs=rs["time_to_recover_secs"],
+        )
+    print(
+        f"# elastic: reshape {out['old_topology']} -> "
+        f"{out['new_topology']} in {out['reshape_downtime_secs']}s, "
+        f"hard-kill restart lost {out.get('steps_lost_hard_kill', '?')} "
+        f"step(s), recovered in "
+        f"{out.get('time_to_recover_secs', '?')}s", flush=True,
+    )
+    return out
+
+
 def bench_telemetry(batch_size=32, reps=3, warmup=5, iters=40):
     """Telemetry-overhead leg: the instrumented train step (on-device
     grad/param/update-norm stats, Trainer(telemetry=True)) vs the bare
@@ -1873,8 +1938,11 @@ def main():
         bench_loaders()
         return
     if args.chaos:
-        # Recovery-overhead leg; tiny model, any backend.
-        print(json.dumps({"chaos": bench_chaos()}))
+        # Recovery-overhead leg; tiny model, any backend — plus the
+        # elastic leg: kill 1 of N simulated hosts mid-run and measure
+        # the reshape downtime / steps-lost / time-to-recover the
+        # committed docs/elastic_chaos_cpu.json artifact pins.
+        print(json.dumps({"chaos": bench_chaos(), "elastic": bench_elastic()}))
         return
     if args.telemetry:
         # Instrumented-vs-bare step time; tiny model, any backend.

@@ -147,8 +147,40 @@ def _apply_ckpt_faults(final_dir: str, epoch: int) -> None:
         fp.truncate(max(size // 2, 1))
 
 
+def state_mesh_topology(state: Any) -> Optional[dict]:
+    """Topology of the mesh that holds ``state`` (axis names/sizes,
+    device and process counts), from the first leaf carrying a
+    ``NamedSharding`` — recorded in every checkpoint manifest and in
+    ``PREEMPTED.json`` so a restore at a DIFFERENT topology knows (and
+    can report) the shape of the world that wrote the checkpoint.
+    None for host-only states (nothing placed on a mesh yet)."""
+    for leaf in jax.tree.leaves(state):
+        sharding = getattr(leaf, "sharding", None)
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            mesh = sharding.mesh
+            return {
+                "axes": {str(a): int(s) for a, s in mesh.shape.items()},
+                "device_count": int(mesh.size),
+                "process_count": int(jax.process_count()),
+            }
+    return None
+
+
+def checkpoint_topology(path: str) -> Optional[dict]:
+    """The ``mesh`` record of a v2/v3 checkpoint manifest (the topology
+    that WROTE it), or None (v1 pickles, pre-topology checkpoints)."""
+    if not os.path.isdir(path):
+        return None
+    try:
+        with open(os.path.join(path, MANIFEST)) as fp:
+            return json.load(fp).get("mesh")
+    except (OSError, ValueError):
+        return None
+
+
 def _write_checkpoint_dir(
-    final_dir: str, state_dict: Any, history: dict, epoch: int
+    final_dir: str, state_dict: Any, history: dict, epoch: int,
+    mesh: Optional[dict] = None,
 ) -> None:
     # The ACTUAL checkpoint I/O (often on the async writer thread): the
     # span shows on the Perfetto timeline whether the write hides behind
@@ -156,11 +188,13 @@ def _write_checkpoint_dir(
     from ml_trainer_tpu.telemetry.spans import span as _span
 
     with _span("ckpt_write_io", epoch=epoch, dir=os.path.basename(final_dir)):
-        _write_checkpoint_dir_inner(final_dir, state_dict, history, epoch)
+        _write_checkpoint_dir_inner(final_dir, state_dict, history, epoch,
+                                    mesh)
 
 
 def _write_checkpoint_dir_inner(
-    final_dir: str, state_dict: Any, history: dict, epoch: int
+    final_dir: str, state_dict: Any, history: dict, epoch: int,
+    mesh: Optional[dict] = None,
 ) -> None:
     tmp_dir = final_dir + ".tmp"
     if os.path.isdir(tmp_dir):
@@ -189,6 +223,9 @@ def _write_checkpoint_dir_inner(
         "epoch": epoch,
         "history": history,
         "leaves": leaves,
+        # Topology of the writing mesh (elastic restore reads it to name
+        # source vs target axes in reshard errors; None pre-placement).
+        "mesh": mesh,
     }
     with open(os.path.join(tmp_dir, MANIFEST), "w") as fp:
         json.dump(manifest, fp)
@@ -248,6 +285,7 @@ def save_checkpoint(
     ``wait_for_checkpoints()`` (the trainer does at fit-end) to surface
     errors."""
     os.makedirs(ckpt_dir, exist_ok=True)
+    mesh = state_mesh_topology(state)  # before the fetch drops shardings
     state_dict = fetch_to_host(serialization.to_state_dict(state))
     # Deep-copy on the caller's thread: the trainer hands us its LIVE
     # history lists, which the next epoch mutates while the writer runs.
@@ -255,7 +293,7 @@ def save_checkpoint(
     path = os.path.join(ckpt_dir, f"{CHECKPOINT_PREFIX}{epoch}")
 
     def job():
-        _write_checkpoint_dir(path, state_dict, history, epoch)
+        _write_checkpoint_dir(path, state_dict, history, epoch, mesh)
         prune_checkpoints(ckpt_dir, keep)
 
     if block:
@@ -340,6 +378,7 @@ def save_checkpoint_sharded(
     nproc = jax.process_count()
     if nproc > 1:
         block = True
+    mesh = state_mesh_topology(state)
     state_dict = serialization.to_state_dict(state)
     final_dir = os.path.join(ckpt_dir, f"{CHECKPOINT_PREFIX}{epoch}")
     history = copy.deepcopy(history)
@@ -431,6 +470,7 @@ def save_checkpoint_sharded(
                     "history": history,
                     "process_count": nproc,
                     "leaves": leaf_meta,
+                    "mesh": mesh,
                 }).encode(),
             )
             _apply_ckpt_faults(final_dir, epoch)
@@ -530,6 +570,34 @@ def _restore_v3(path: str, manifest: dict, state_template: Any, shardings):
         dtype = np.dtype(meta["dtype"])
         pieces = tables.get(i, [])
         sharding = shard_leaves.get(lpath) if shard_leaves else None
+        if isinstance(sharding, jax.sharding.NamedSharding):
+            # Elastic restore onto a DIFFERENT mesh: fail with a
+            # structured error naming the saved vs target axes when a
+            # saved shape does not divide the new mesh — BEFORE any
+            # device allocates (the alternative is an opaque reshape
+            # traceback out of make_array_from_callback).
+            from ml_trainer_tpu.resilience.elastic import (
+                ReshardError,
+                _spec_axis_size,
+            )
+
+            target = {
+                "axes": {
+                    str(a): int(s) for a, s in sharding.mesh.shape.items()
+                },
+                "device_count": int(sharding.mesh.size),
+            }
+            for dim, entry in enumerate(tuple(sharding.spec)[:len(shape)]):
+                if entry is None:
+                    continue
+                n = _spec_axis_size(entry, sharding.mesh)
+                if n > 1 and shape[dim] % n:
+                    raise ReshardError(
+                        leaf="/".join(lpath), dim=dim, size=shape[dim],
+                        axes=entry, axis_size=n,
+                        source_topology=manifest.get("mesh"),
+                        target_topology=target,
+                    )
         if sharding is not None and isinstance(
             sharding, jax.sharding.Sharding
         ):

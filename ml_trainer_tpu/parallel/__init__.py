@@ -32,6 +32,7 @@ from ml_trainer_tpu.parallel.sharding import (
     place_tree,
     plan_grad_buckets,
     replicated,
+    respec_sharding,
     shard_opt_state,
     shard_params,
     zero1_opt_shardings,
@@ -80,6 +81,7 @@ __all__ = [
     "place_tree",
     "plan_grad_buckets",
     "replicated",
+    "respec_sharding",
     "shard_opt_state",
     "shard_params",
     "zero1_opt_shardings",

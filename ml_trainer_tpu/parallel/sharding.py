@@ -108,6 +108,31 @@ def logical_to_shardings(
     return jax.tree_util.tree_map_with_path(resolve, tree)
 
 
+def respec_sharding(sharding: NamedSharding, new_mesh: Mesh) -> NamedSharding:
+    """Carry one leaf's PartitionSpec onto a different mesh, dropping
+    axes the new mesh no longer has (an axis shrunk to 1 disappears
+    from the mesh — its entries replicate, the same rule
+    :func:`logical_to_shardings` applies).  The elastic-reshape path
+    (resilience/elastic.py) uses this to re-bind a whole state tree's
+    placement after the mesh loses a host."""
+    cleaned = P(
+        *(
+            a
+            if (
+                a is None
+                or (isinstance(a, str) and a in new_mesh.axis_names)
+                or (
+                    isinstance(a, tuple)
+                    and all(x in new_mesh.axis_names for x in a)
+                )
+            )
+            else None
+            for a in sharding.spec
+        )
+    )
+    return NamedSharding(new_mesh, cleaned)
+
+
 def place_tree(tree, shardings):
     """Place a pytree onto per-leaf shardings, multi-host-safely.
 

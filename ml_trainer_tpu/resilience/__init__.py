@@ -6,9 +6,19 @@ the defenses live where the failures do — the trainer's on-device
 all-finite guard, step-granular checkpoints and preemption handling
 (``trainer.py``), checkpoint CRC verification and corrupt-dir quarantine
 (``checkpoint/checkpoint.py``), and the serving watchdog/drain
-(``serving/api.py``).
+(``serving/api.py``).  Elastic training — topology-flexible restore and
+the drain→reshape→continue controller — lives in
+:mod:`ml_trainer_tpu.resilience.elastic`.
 """
 
+from ml_trainer_tpu.resilience.elastic import (
+    ElasticConfig,
+    ReshardError,
+    TopologyError,
+    elastic_restore,
+    precheck_topology,
+    validate_reshard,
+)
 from ml_trainer_tpu.resilience.faults import (
     ENV_VAR,
     Fault,
@@ -21,10 +31,16 @@ from ml_trainer_tpu.resilience.faults import (
 
 __all__ = [
     "ENV_VAR",
+    "ElasticConfig",
     "Fault",
     "FaultPlan",
+    "ReshardError",
+    "TopologyError",
     "active_plan",
+    "elastic_restore",
     "injected",
     "install",
+    "precheck_topology",
     "uninstall",
+    "validate_reshard",
 ]

@@ -1,0 +1,424 @@
+"""Elastic training: reshape the mesh around a lost host and keep going.
+
+Preemption used to mean emergency-checkpoint + full restart at the SAME
+topology.  This module closes ROADMAP item #1 with the two halves of the
+TorchTitan-style drain→reshape→continue behavior (arXiv 2410.06511; the
+mesh-reshaping framing is the pjit/TPUv4 paper, arXiv 2204.06514):
+
+* **Topology-flexible restore** — any checkpoint (v2 full-tree, v3
+  per-host shards; pure-DP, ZeRO-1, TP/FSDP rule-sharded, pipeline)
+  reshards onto a DIFFERENT device count / mesh shape.  The target
+  placement is decided here — :func:`remap_state_shardings` carries each
+  leaf's PartitionSpec onto the new mesh with the ZeRO-1 shape rule
+  re-applied — validated BEFORE any device allocates:
+  :func:`precheck_topology` prices the target topology through the
+  analytic memory ledger (``plan_train_memory``) and raises a structured
+  :class:`TopologyError` when it cannot fit, and
+  :func:`validate_reshard` raises a structured :class:`ReshardError`
+  naming the offending leaf/dim/axis when a saved shape does not divide
+  the new mesh (instead of an XLA reshape traceback).  The placement
+  itself is ONE whole-tree ``place_tree`` program (v2) or the v3
+  stitch-per-device restore.
+
+* **Elastic controller** — configuration for the Trainer's in-flight
+  reshape: ``Trainer(elastic=ElasticConfig(n_hosts=N))`` treats the
+  local mesh as N simulated hosts (the chaos-harness analog of a TPU
+  pod's host groups; ``data`` is the outermost mesh axis, so each host
+  owns a contiguous block of data replicas).  On a ``host_kill`` /
+  ``host_hang`` fault (resilience/faults.py) or a straggler verdict
+  from ``telemetry/cluster.py``, the trainer drains the in-flight step,
+  writes the emergency checkpoint, drops the lost host's devices from
+  the mesh, re-places the state (one ``place_tree``), rescales global
+  batch / LR per :attr:`ElasticConfig.batch_policy`, and continues the
+  SAME ``fit()`` call — recorded in ``history['reshapes']``, a flight
+  ``reshape`` event, the goodput ``reshape`` bucket and
+  ``run_report.json``.
+
+Multi-process pods cannot reshape in place (the process set is fixed at
+``jax.distributed.initialize``); there the same faults drive the
+drain→checkpoint→restart-at-new-topology path, and the topology-flexible
+restore is what lets the restarted job continue (tests/test_elastic.py,
+scripts/elastic_smoke.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# ------------------------------------------------------- structured errors
+class ReshardError(ValueError):
+    """A saved/live array cannot be placed on the target mesh: some
+    dimension does not divide the mesh axes its PartitionSpec names.
+    Carries the coordinates a post-mortem needs instead of an XLA
+    reshape traceback: the leaf path, the offending dim/size, the axis
+    and its size, and the source vs target topologies."""
+
+    def __init__(self, *, leaf: str, dim: int, size: int, axes,
+                 axis_size: int,
+                 source_topology: Optional[dict] = None,
+                 target_topology: Optional[dict] = None,
+                 reason: Optional[str] = None):
+        self.leaf = leaf
+        self.dim = int(dim)
+        self.size = int(size)
+        self.axes = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+        self.axis_size = int(axis_size)
+        self.source_topology = source_topology
+        self.target_topology = target_topology
+        axis_str = "x".join(str(a) for a in self.axes)
+        msg = (
+            f"cannot reshard leaf {leaf!r}: dim {dim} of size {size} does "
+            f"not divide mesh axis {axis_str!r} of size {axis_size}"
+        )
+        if source_topology:
+            msg += f" (saved on mesh {_topo_str(source_topology)}"
+            msg += (
+                f", restoring onto {_topo_str(target_topology)})"
+                if target_topology else ")"
+            )
+        elif target_topology:
+            msg += f" (target mesh {_topo_str(target_topology)})"
+        if reason:
+            msg += f"; {reason}"
+        super().__init__(msg)
+
+
+class TopologyError(ValueError):
+    """The target topology cannot run this config: the analytic memory
+    ledger predicts the per-device peak exceeds chip capacity (checked
+    BEFORE any device allocates), or the mesh cannot be built around
+    the lost host at all.  ``verdict`` carries the planner's numbers."""
+
+    def __init__(self, message: str, verdict: Optional[dict] = None):
+        self.verdict = verdict or {}
+        super().__init__(message)
+
+
+def _topo_str(topo: Optional[dict]) -> str:
+    if not topo:
+        return "<unknown>"
+    axes = topo.get("axes", topo)
+    if isinstance(axes, dict):
+        return "{" + ", ".join(f"{a}: {s}" for a, s in axes.items()) + "}"
+    return str(axes)
+
+
+# -------------------------------------------------------------- topologies
+def mesh_topology(mesh: Mesh) -> Dict[str, Any]:
+    """The JSON-able topology record of a mesh — what checkpoint
+    manifests and ``PREEMPTED.json`` carry so a restore knows the shape
+    of the world that wrote them."""
+    return {
+        "axes": {str(a): int(s) for a, s in mesh.shape.items()},
+        "device_count": int(mesh.size),
+        "process_count": int(jax.process_count()),
+    }
+
+
+def state_topology(tree) -> Optional[Dict[str, Any]]:
+    """Topology of the first mesh-placed leaf in ``tree`` (None when no
+    leaf carries a ``NamedSharding`` — host-only states)."""
+    for leaf in jax.tree.leaves(tree):
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            return mesh_topology(sh.mesh)
+    return None
+
+
+def host_groups(devices: Sequence, n_hosts: int) -> List[list]:
+    """Split a mesh's flat device list into ``n_hosts`` equal contiguous
+    groups — the simulated-host decomposition.  ``data`` is the
+    outermost mesh axis (parallel/mesh.py AXIS_ORDER), so each group is
+    a contiguous block of data replicas and dropping one leaves a valid
+    (smaller) mesh grid."""
+    devices = list(devices)
+    if n_hosts < 2:
+        raise ValueError(f"n_hosts must be >= 2, got {n_hosts}")
+    if len(devices) % n_hosts:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_hosts} equal "
+            "simulated hosts"
+        )
+    per = len(devices) // n_hosts
+    return [devices[h * per:(h + 1) * per] for h in range(n_hosts)]
+
+
+def shrink_mesh_shape(old_shape: Dict[str, int], old_n: int,
+                      new_n: int) -> Dict[str, int]:
+    """The mesh shape after losing ``old_n - new_n`` devices: the
+    ``data`` axis absorbs the whole shrink (model axes — tensor / fsdp /
+    stage — partition the MODEL; shrinking them would change the
+    program, not just the replica count).  Raises :class:`TopologyError`
+    when the surviving devices cannot keep the model axes whole."""
+    old_shape = {str(a): int(s) for a, s in old_shape.items()}
+    model = {a: s for a, s in old_shape.items() if a != "data"}
+    model_n = int(np.prod(list(model.values()), initial=1))
+    if new_n < 1 or new_n % model_n:
+        raise TopologyError(
+            f"cannot reshape {old_n} -> {new_n} devices: the surviving "
+            f"device count must keep the model axes {model} whole "
+            f"(multiple of {model_n})",
+            verdict={"old_devices": old_n, "new_devices": new_n,
+                     "model_axes": model},
+        )
+    new_data = new_n // model_n
+    out = dict(old_shape)
+    out["data"] = new_data
+    return out
+
+
+# ------------------------------------------------- reshard spec remapping
+# The per-leaf spec carry-over lives with the other placement rules in
+# parallel/sharding.py; re-exported here as part of the elastic API.
+from ml_trainer_tpu.parallel.sharding import respec_sharding  # noqa: E402
+
+
+def _spec_axis_size(entry, mesh: Mesh) -> int:
+    axes = entry if isinstance(entry, tuple) else (entry,)
+    return int(np.prod([mesh.shape[a] for a in axes], initial=1))
+
+
+def remap_state_shardings(shardings, state, new_mesh: Mesh):
+    """Per-leaf target shardings for a whole state tree on a new mesh.
+
+    Each leaf keeps its spec (re-bound to the new mesh); leaves carrying
+    the ZeRO-1 signature — dim 0 partitioned over the data-like axes,
+    all other dims replicated — fall back to replicated when dim 0 no
+    longer divides the new axis size, exactly the shape rule
+    ``zero1_opt_shardings`` would have applied on the new mesh.  Leaves
+    sharded by MODEL rules (tensor/fsdp/stage dims) never silently
+    replicate — an indivisible model shard is a :class:`ReshardError`
+    the caller surfaces via :func:`validate_reshard`."""
+    data_like = ("data",)
+
+    def remap(sharding, leaf):
+        if not isinstance(sharding, NamedSharding):
+            return sharding
+        new = respec_sharding(sharding, new_mesh)
+        spec = tuple(new.spec)
+        shape = tuple(getattr(leaf, "shape", ()))
+        if (
+            shape
+            and len(spec) >= 1
+            and spec[0] is not None
+            and all(e is None for e in spec[1:])
+            and all(
+                a in data_like
+                for a in (spec[0] if isinstance(spec[0], tuple) else (spec[0],))
+            )
+        ):
+            n = _spec_axis_size(spec[0], new_mesh)
+            if n > 1 and shape[0] % n:
+                return NamedSharding(new_mesh, P())  # zero1 shape rule
+        return new
+
+    return jax.tree.map(remap, shardings, state)
+
+
+def validate_reshard(state, shardings, *,
+                     source_topology: Optional[dict] = None) -> None:
+    """Check that every leaf's shape divides its target sharding's mesh
+    axes — the divisibility contract an elastic restore must satisfy —
+    and raise a structured :class:`ReshardError` naming the first
+    offender.  Pure metadata: nothing allocates.  ``state`` may hold
+    real arrays, numpy, or ``ShapeDtypeStruct`` leaves."""
+    leaves = jax.tree_util.tree_flatten_with_path(state)[0]
+    sh_leaves = jax.tree.leaves(shardings)
+    if len(leaves) != len(sh_leaves):
+        raise ValueError(
+            f"state/shardings tree mismatch: {len(leaves)} vs "
+            f"{len(sh_leaves)} leaves"
+        )
+    from ml_trainer_tpu.parallel.sharding import path_str
+
+    for (path, leaf), sharding in zip(leaves, sh_leaves):
+        if not isinstance(sharding, NamedSharding):
+            continue
+        shape = tuple(getattr(leaf, "shape", ()))
+        target = mesh_topology(sharding.mesh)
+        for dim, entry in enumerate(tuple(sharding.spec)[:len(shape)]):
+            if entry is None:
+                continue
+            n = _spec_axis_size(entry, sharding.mesh)
+            if n > 1 and shape[dim] % n:
+                raise ReshardError(
+                    leaf=path_str(path), dim=dim, size=shape[dim],
+                    axes=entry, axis_size=n,
+                    source_topology=source_topology,
+                    target_topology=target,
+                )
+
+
+# -------------------------------------------------- pre-allocation checks
+def precheck_topology(model, batch_shape: Sequence[int],
+                      mesh_shape: Optional[Dict[str, int]] = None, *,
+                      optimizer: str = "adamw",
+                      capacity_bytes: Optional[float] = None,
+                      margin: float = 0.95,
+                      **plan_kwargs) -> dict:
+    """Price a target topology through the analytic memory ledger BEFORE
+    any device allocates (``plan_train_memory`` is ``jax.eval_shape``
+    only) and raise :class:`TopologyError` when the predicted per-device
+    peak exceeds ``margin`` × chip capacity.  Returns the planner's
+    verdict dict on success — the elastic controller calls this with the
+    post-reshape mesh shape, so a reshape that cannot fit fails with the
+    planner's numbers instead of a device OOM mid-recovery."""
+    from ml_trainer_tpu.telemetry.memory import fit_verdict, plan_train_memory
+
+    ledger = plan_train_memory(
+        model, tuple(batch_shape), optimizer=optimizer,
+        mesh_shape=mesh_shape, **plan_kwargs,
+    )
+    verdict = fit_verdict(
+        ledger.peak_bytes(), capacity_bytes=capacity_bytes, margin=margin
+    )
+    verdict["mesh_shape"] = dict(mesh_shape or {})
+    if verdict["verdict"] == "oom" or (
+        capacity_bytes is not None and verdict["utilization"] > 1.0
+    ):
+        raise TopologyError(
+            f"target topology {_topo_str({'axes': mesh_shape or {}})} "
+            f"cannot fit: predicted per-device peak "
+            f"{verdict['peak_bytes']:,} bytes exceeds capacity "
+            f"{verdict['capacity_bytes']:,} "
+            f"(utilization {verdict['utilization']:.2f})",
+            verdict=verdict,
+        )
+    return verdict
+
+
+# ------------------------------------------------- topology-flexible load
+def elastic_restore(path: str, state_template, shardings, *,
+                    validate: bool = True):
+    """Restore a checkpoint onto a (possibly different) target topology.
+
+    * v3 per-host shard directories stitch each device's slice directly
+      onto ``shardings`` (the saved piece grid and the target shard grid
+      need not match);
+    * v2 full-tree directories (and legacy v1 pickles) restore to host
+      arrays and place the WHOLE tree in one ``place_tree`` program.
+
+    ``validate=True`` (default) runs :func:`validate_reshard` against
+    the template shapes first, so an incompatible topology fails with a
+    structured :class:`ReshardError` before any device allocates.
+    Returns ``(state, history, epoch)`` like ``restore_checkpoint``."""
+    from ml_trainer_tpu import checkpoint as ckpt
+    from ml_trainer_tpu.parallel.sharding import place_tree
+
+    source = ckpt.checkpoint_topology(path)
+    if validate:
+        validate_reshard(
+            state_template, shardings,
+            source_topology=source,
+        )
+    if ckpt.checkpoint_format(path) == 3:
+        return ckpt.restore_checkpoint(path, state_template, shardings)
+    state, history, epoch = ckpt.restore_checkpoint(
+        path, jax.device_get(state_template)
+    )
+    return place_tree(state, shardings), history, epoch
+
+
+# ----------------------------------------------------- controller config
+@dataclass
+class ElasticConfig:
+    """Knobs of the Trainer's in-flight mesh reshape.
+
+    ``n_hosts``
+        Simulated host count the local mesh decomposes into (each host =
+        one contiguous block of data replicas).  The ``data`` axis must
+        be divisible by it.
+
+    ``batch_policy``
+        ``'global'`` (default): the global batch is PRESERVED across a
+        reshape — each survivor takes a larger per-device share, the
+        math (and therefore the trajectory) is unchanged, and the
+        mid-epoch cursor carries over directly.  ``'per_device'``: the
+        per-device batch is preserved — the global batch shrinks by the
+        survivor ratio and the LR rescales by the same factor (the
+        linear scaling rule), trading trajectory identity for constant
+        per-device memory/latency.
+
+    ``straggler_reshape_factor``
+        When set, a straggler verdict from ``telemetry/cluster.py``
+        whose factor reaches this bound requests a reshape around the
+        straggling host (None = stragglers only alarm).
+
+    ``max_reshapes``
+        Hard cap on in-flight reshapes per ``fit()`` (a flapping
+        cluster must not shrink itself to nothing).
+
+    ``capacity_bytes`` / ``margin``
+        Overrides for the pre-reshape :func:`precheck_topology` fit
+        check (None = the chip HBM table)."""
+
+    n_hosts: int = 2
+    batch_policy: str = "global"
+    straggler_reshape_factor: Optional[float] = None
+    max_reshapes: int = 8
+    capacity_bytes: Optional[float] = None
+    margin: float = 0.95
+    min_hosts: int = 1
+
+    def __post_init__(self):
+        if self.n_hosts < 2:
+            raise ValueError(
+                f"elastic n_hosts must be >= 2, got {self.n_hosts}"
+            )
+        if self.batch_policy not in ("global", "per_device"):
+            raise ValueError(
+                "elastic batch_policy must be 'global' | 'per_device', "
+                f"got {self.batch_policy!r}"
+            )
+        if (
+            self.straggler_reshape_factor is not None
+            and self.straggler_reshape_factor <= 1.0
+        ):
+            raise ValueError(
+                "straggler_reshape_factor must be > 1, got "
+                f"{self.straggler_reshape_factor}"
+            )
+        if self.max_reshapes < 1:
+            raise ValueError(
+                f"max_reshapes must be >= 1, got {self.max_reshapes}"
+            )
+        if not (1 <= self.min_hosts < self.n_hosts):
+            raise ValueError(
+                f"min_hosts must be in [1, n_hosts), got {self.min_hosts}"
+            )
+
+
+def resolve_elastic(value) -> Optional[ElasticConfig]:
+    """``Trainer(elastic=...)`` resolution: None stays off, an int is
+    the simulated host count, a config passes through."""
+    if value is None or value is False:
+        return None
+    if isinstance(value, ElasticConfig):
+        return value
+    if isinstance(value, bool):  # True without a host count is ambiguous
+        raise ValueError(
+            "elastic=True is ambiguous; pass the simulated host count "
+            "(elastic=2) or an ElasticConfig"
+        )
+    if isinstance(value, int):
+        return ElasticConfig(n_hosts=value)
+    raise TypeError(
+        f"elastic must be None, an int host count, or ElasticConfig; "
+        f"got {type(value).__name__}"
+    )
+
+
+@dataclass
+class ReshapeRequest:
+    """One pending drain→reshape request (trigger + the lost host)."""
+
+    trigger: str  # 'host_kill' | 'host_hang' | 'straggler'
+    lost_host: int
+    step: Optional[int] = None
+    detail: dict = field(default_factory=dict)

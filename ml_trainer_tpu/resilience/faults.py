@@ -21,15 +21,29 @@ TPU job fails in:
 * ``decode_error``  — NativeLoader: the matching epoch reports an
                       injected decode failure through the loader's
                       corrupt-sample accounting path.
+* ``host_kill``     — elastic chaos: the named host dies at the
+                      matching step.  In a multi-process cluster the
+                      matching worker hard-exits mid-loop (the SIGKILL'd
+                      pod host — no emergency checkpoint); in the
+                      single-process simulated cluster the elastic
+                      controller drains, checkpoints, and reshapes the
+                      mesh around the lost host (resilience/elastic.py).
+* ``host_hang``     — elastic chaos: the named host stalls.  A matching
+                      multi-process worker sleeps ``secs`` (a real
+                      straggler for telemetry/cluster.py to catch); the
+                      single-process simulation treats it as a
+                      straggler verdict and reshapes.
 
 Spec syntax (also accepted via the ``ML_TRAINER_TPU_FAULTS`` env var)::
 
     nan_grad@step=12;ckpt_truncate@epoch=1;preempt@step=40;decode_wedge@step=5
+    host_kill@step=9,host=1
 
 Entries are ``kind@key=value[,key=value...]`` separated by ``;``.
 Trigger keys: ``step`` (1-based train/decode step) or ``epoch``.
-Params: ``count`` (consecutive steps to fire on, default 1) and
-``secs`` (wedge hold bound, default 300).
+Params: ``count`` (consecutive steps to fire on, default 1), ``secs``
+(wedge/hang hold bound, default 300), and ``host`` (the host index a
+``host_kill``/``host_hang`` names, default 0).
 
 Every hook is a no-op when no plan is active, and every fault fires a
 bounded number of times — injection is reproducible, never ambient.
@@ -47,7 +61,8 @@ from typing import List, Optional
 
 ENV_VAR = "ML_TRAINER_TPU_FAULTS"
 
-KINDS = ("nan_grad", "preempt", "ckpt_truncate", "decode_wedge", "decode_error")
+KINDS = ("nan_grad", "preempt", "ckpt_truncate", "decode_wedge",
+         "decode_error", "host_kill", "host_hang")
 
 
 @dataclass
@@ -60,6 +75,7 @@ class Fault:
     epoch: Optional[int] = None
     count: int = 1
     secs: float = 300.0
+    host: int = 0  # the host index a host_kill/host_hang names
     fired: int = 0
 
     def matches(self, step: Optional[int], epoch: Optional[int]) -> bool:
@@ -81,6 +97,8 @@ class Fault:
             parts.append(f"epoch={self.epoch}")
         if self.count != 1:
             parts.append(f"count={self.count}")
+        if self.kind in ("host_kill", "host_hang"):
+            parts.append(f"host={self.host}")
         return self.kind + ("@" + ",".join(parts) if parts else "")
 
 
@@ -122,12 +140,17 @@ class FaultPlan:
                         "(expected key=value)"
                     )
                 key = key.strip()
-                if key not in ("step", "epoch", "count", "secs"):
+                if key not in ("step", "epoch", "count", "secs", "host"):
                     raise ValueError(
                         f"unknown fault key {key!r} in {entry!r}; "
-                        "expected step|epoch|count|secs"
+                        "expected step|epoch|count|secs|host"
                     )
                 kwargs[key] = float(value) if key == "secs" else int(value)
+            if "host" in kwargs and kind not in ("host_kill", "host_hang"):
+                raise ValueError(
+                    f"'host' only applies to host_kill/host_hang faults "
+                    f"(got it on {kind!r} in {entry!r})"
+                )
             faults.append(Fault(kind=kind, **kwargs))
         return cls(faults)
 

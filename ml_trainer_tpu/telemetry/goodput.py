@@ -24,6 +24,11 @@ bucket               accounted by
 ``rollback``         rollback-to-last-good restores (NaN escape hatch)
 ``preempt_gap``      downtime between a preemption exit and the resume
                      that consumed its marker (``PREEMPTED.json`` age)
+``reshape``          elastic mesh reshape around a lost host: drain +
+                     emergency checkpoint + whole-tree re-placement +
+                     loader/step rebuild (``resilience/elastic.py``) —
+                     elastic downtime is attributed here, never folded
+                     silently into compute
 ===================  ====================================================
 
 Everything not in a bucket is **compute** — the remainder against the
@@ -45,6 +50,7 @@ from typing import Dict, Optional
 
 BUCKETS = (
     "data_wait", "h2d", "ckpt_stall", "compile", "rollback", "preempt_gap",
+    "reshape",
 )
 
 _lock = threading.Lock()
@@ -132,7 +138,8 @@ class GoodputMeter:
         self.g_fraction = r.gauge(
             "train_goodput_fraction",
             "fraction of wall-clock spent in productive train compute "
-            "(1 - data_wait/h2d/ckpt_stall/compile/rollback/preempt_gap)",
+            "(1 - data_wait/h2d/ckpt_stall/compile/rollback/preempt_gap/"
+            "reshape)",
         )
         self.g_bucket = r.gauge(
             "train_goodput_seconds_total",

@@ -203,6 +203,7 @@ class Trainer:
         dp_update: str = "fused",
         bucket_mb: float = 4.0,
         pipeline_schedule: Optional[str] = None,
+        elastic: Any = None,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -415,7 +416,25 @@ class Trainer:
         in-flight step, write an emergency mid-epoch checkpoint plus a
         clean-exit marker, and return with ``self.preempted = True`` —
         the preemptible-TPU contract.  ``fit(resume=True)`` picks the
-        marker up and continues where the signal landed."""
+        marker up and continues where the signal landed.
+
+        ``elastic`` (docs/resilience.md "Elastic"): an int simulated
+        host count or a ``resilience.elastic.ElasticConfig``.  The mesh
+        decomposes into N equal host groups (contiguous blocks of data
+        replicas); a ``host_kill``/``host_hang`` fault or a straggler
+        verdict from ``telemetry/cluster.py`` whose factor reaches
+        ``straggler_reshape_factor`` then drains the in-flight step,
+        writes the emergency checkpoint, drops the lost host's devices,
+        re-places the state in ONE ``place_tree`` program, rescales
+        global batch / LR per ``batch_policy``, and continues the SAME
+        ``fit()`` call — each event recorded in ``history['reshapes']``,
+        a flight ``reshape`` event and the goodput ``reshape`` bucket.
+        Single-process (simulated cluster) only: a real multi-process
+        pod cannot reshape its process set in place, so there the same
+        faults drive the drain→checkpoint→restart path and the
+        topology-flexible restore continues the job at the new shape.
+        Requires ``steps_per_execution=1`` (the drain needs the
+        per-batch cursor)."""
         logger.info("Config inputs.", config=config)
         cfg = TrainerConfig.from_kwargs(**config)
         self.config = cfg
@@ -656,6 +675,21 @@ class Trainer:
         )
         self.preempted = False
         self._preempt_requested = False
+        from ml_trainer_tpu.resilience.elastic import resolve_elastic
+
+        self.elastic = resolve_elastic(elastic)
+        if self.elastic is not None and self.steps_per_execution > 1:
+            raise ValueError(
+                "elastic reshape requires steps_per_execution=1: the "
+                "drain needs the per-batch cursor the multi-step scan "
+                "dispatch does not keep"
+            )
+        self.reshapes: list = []  # elastic mesh-reshape records this run
+        self._reshape_request = None  # pending drain (set between steps)
+        self._reshape_pending: Optional[dict] = None  # drained; reshape due
+        self._live_hosts: list = (
+            list(range(self.elastic.n_hosts)) if self.elastic else []
+        )
         self.rollbacks = 0  # rollback-to-last-good events this run
         self.skipped_steps: list = []  # per-epoch skipped-step counts
         self._skipped_base = 0  # cumulative counter at current epoch start
@@ -685,6 +719,21 @@ class Trainer:
         ) if any(a in self.mesh.axis_names for a in ("data", "fsdp")) else 1
         self._batch_sharding = batch_sharding(self.mesh)
         self._replicated = replicated(self.mesh)
+        if self.elastic is not None and process_count() == 1:
+            # Simulated host groups: data is the outermost mesh axis, so
+            # each host must own an equal contiguous block of data
+            # replicas for the post-kill grid to stay a valid mesh.
+            n_hosts = self.elastic.n_hosts
+            data = int(self.mesh.shape.get("data", 1))
+            if data < n_hosts or data % n_hosts or (
+                int(self.mesh.size) % n_hosts
+            ):
+                raise ValueError(
+                    f"elastic n_hosts={n_hosts} needs the mesh's data "
+                    f"axis (size {data} over {int(self.mesh.size)} "
+                    "devices) to split into equal host groups; pass a "
+                    "mesh_shape whose data axis is divisible by n_hosts"
+                )
         if self.dp_update == "sharded":
             # Pure-DP only: the sharded update re-expresses the gradient
             # psum as explicit reduce-scatter/all-gather over the data
@@ -758,6 +807,9 @@ class Trainer:
 
         if datasets:
             train_set, val_set = datasets
+            # Retained for elastic reshapes: the 'per_device' batch
+            # policy rebuilds the loaders at the shrunk global batch.
+            self._datasets = (train_set, val_set)
             self._build_loaders(train_set, val_set, batch_size, cfg)
             self._build_state_and_steps(cfg)
         else:
@@ -1129,6 +1181,10 @@ class Trainer:
             self._cluster = ClusterTelemetry(
                 flight=self._flight,
                 straggler_factor=self.straggler_factor,
+                # Straggler VERDICT hook: the elastic controller turns a
+                # straggler past its reshape factor into a drain+reshape
+                # request (self-gating — a no-op without elastic=).
+                on_straggler=self._on_straggler_verdict,
             )
             self._telemetry = TrainTelemetry(
                 model=self.model,
@@ -1186,6 +1242,14 @@ class Trainer:
                     self._memory_ledger.peak_bytes() / 2 ** 20, 2
                 ),
             )
+        self._build_steps()
+
+    def _build_steps(self) -> None:
+        """(Re)build the compiled train/eval steps against the CURRENT
+        mesh, shardings and bucket plan.  Split from
+        ``_build_state_and_steps`` so an elastic reshape
+        (``_perform_reshape``) can rebuild the programs after swapping
+        the mesh under the same Trainer."""
         train_step = (
             self._make_sharded_train_step()
             if self.dp_update == "sharded" else self._make_train_step()
@@ -1886,6 +1950,7 @@ class Trainer:
                             self._request_preemption("injected preempt")
                         if plan.fire("nan_grad", step=gstep) is not None:
                             x = self._poison_batch(x)
+                        self._poll_host_faults(plan, gstep)
                     out = self._train_step(self.state, x, y, lr_scale)
                     self.state, loss, metric_val = out[0], out[1], out[2]
                     if self.telemetry:
@@ -1956,8 +2021,31 @@ class Trainer:
                         }
                         self.preempted = True
                         break
-            if self.preempted:
-                return  # partial epoch: no history entry, fit() stops
+                    if self._reshape_request is not None:
+                        # Elastic drain: the in-flight step committed;
+                        # emergency-checkpoint the cursor (crash safety
+                        # while the mesh is being rebuilt), then hand
+                        # the reshape to _fit's loop.
+                        self._save_mid_epoch(
+                            epoch, done, loss_sum, metric_sum
+                        )
+                        ckpt.wait_for_checkpoints()
+                        req, self._reshape_request = (
+                            self._reshape_request, None
+                        )
+                        self._reshape_pending = {
+                            "request": req,
+                            "epoch": epoch,
+                            "step": gstep,
+                            "batches_done": done,
+                            # The drain fence: the in-flight step must
+                            # land before the mesh is rebuilt.
+                            "loss_sum": float(loss_sum),  # graft-lint: sync-ok
+                            "metric_sum": float(metric_sum),  # graft-lint: sync-ok
+                        }
+                        break
+            if self.preempted or self._reshape_pending is not None:
+                return  # partial epoch: no history entry yet
         # float(loss_sum) above fenced the device work, so this timestamp
         # covers actual execution, not async dispatch.
         self.train_losses.append(float(loss_sum) / n)  # graft-lint: sync-ok
@@ -2137,6 +2225,8 @@ class Trainer:
         self.preempted = False
         self._preempt_requested = False
         self._preempt_info: Optional[dict] = None
+        self._reshape_request = None
+        self._reshape_pending = None
         prev_handlers = self._install_preempt_handlers()
         try:
             self._fit(resume)
@@ -2212,7 +2302,7 @@ class Trainer:
                 self._telemetry.goodput.start()
         if resume:
             start_epoch = self._resume_from_latest(ckpt_dir)
-        first_epoch = True
+        self._mark_warm_after_epoch = True
         for epoch in range(start_epoch, self.epochs + 1):
             # Checked at loop entry so a resumed run that comes back
             # already out of patience stops BEFORE training (and
@@ -2221,6 +2311,12 @@ class Trainer:
                 break
             logger.info(f"{'-' * 30} EPOCH {epoch} / {self.epochs} {'-' * 30}")
             self._train_one_epoch(epoch)
+            while self._reshape_pending is not None:
+                # Elastic reshape: the epoch drained mid-flight; rebuild
+                # the mesh around the lost host and re-enter the SAME
+                # epoch at the saved cursor (resilience/elastic.py).
+                self._perform_reshape()
+                self._train_one_epoch(epoch)
             if self.preempted:
                 self._write_preempt_marker(ckpt_dir)
                 self._flight.record(
@@ -2238,12 +2334,14 @@ class Trainer:
             self.clear()
             self._validate_one_epoch()
             self.clear()
-            if first_epoch:
+            if self._mark_warm_after_epoch:
                 # Every program a steady-state epoch needs (train + eval,
                 # full and ragged-tail shapes) has now compiled: any
                 # compile from here on is a recompile incident the watch
-                # records with flight forensics.
-                first_epoch = False
+                # records with flight forensics.  An elastic reshape
+                # re-arms this flag — the reshaped mesh legitimately
+                # compiles fresh programs for one epoch.
+                self._mark_warm_after_epoch = False
                 if self.telemetry:
                     from ml_trainer_tpu.telemetry import compile_watch
 
@@ -2274,6 +2372,19 @@ class Trainer:
                 # host 0's /metrics and JSONL sink carry cluster_* series
                 # for the whole pod.
                 self._cluster.sync(step=epoch * self.steps_per_epoch)
+            if self._reshape_request is not None:
+                # Boundary reshape (a straggler verdict from the
+                # epoch-end aggregation): the epoch is complete, so no
+                # mid-epoch cursor carries over — the next epoch starts
+                # on the reshaped mesh.
+                req, self._reshape_request = self._reshape_request, None
+                self._reshape_pending = {
+                    "request": req, "epoch": epoch,
+                    "step": epoch * self.steps_per_epoch,
+                    "batches_done": None, "loss_sum": 0.0,
+                    "metric_sum": 0.0,
+                }
+                self._perform_reshape()
             # Save on the primary host only (ref: src/trainer.py:252-254).
             # When params are genuinely PARTITIONED across hosts (TP/FSDP
             # multi-host), the fetch is a global allgather — a collective —
@@ -2350,10 +2461,12 @@ class Trainer:
             "val_metric": self.val_metrics,
             "metric_type": self.metric,
             # Per-epoch count of steps the on-device all-finite guard
-            # skipped (all zeros on a healthy run), and the number of
-            # rollback-to-last-good events — the resilience ledger.
+            # skipped (all zeros on a healthy run), the number of
+            # rollback-to-last-good events, and the elastic mesh
+            # reshapes survived — the resilience ledger.
             "skipped_steps": self.skipped_steps,
             "rollbacks": self.rollbacks,
+            "reshapes": self.reshapes,
         }
         if self.save_history and is_primary():
             self.save_history_(self.model_dir)
@@ -2386,6 +2499,7 @@ class Trainer:
             "lr_scale": self._lr_scale,
             "skipped_steps": self.skipped_steps,
             "rollbacks": self.rollbacks,
+            "reshapes": self.reshapes,
         }
         if self._plateau is not None:
             h["plateau"] = {
@@ -2411,6 +2525,7 @@ class Trainer:
         self.val_metrics = list(saved.get("val_metric", []))
         self.skipped_steps = list(saved.get("skipped_steps", []))
         self.rollbacks = int(saved.get("rollbacks", 0))
+        self.reshapes = list(saved.get("reshapes", []))
         self._lr_scale = float(saved.get("lr_scale", 1.0))
         plateau = saved.get("plateau", {})
         if self._plateau is not None:
@@ -2422,6 +2537,271 @@ class Trainer:
         self._bad_epochs = int(early.get("bad_epochs", 0))
 
     # ------------------------------------------------------------ resilience
+    def _poll_host_faults(self, plan, gstep: int) -> None:
+        """``host_kill`` / ``host_hang`` injection (resilience/faults.py).
+
+        Multi-process: the MATCHING worker is the failing host — it
+        hard-exits (kill: the SIGKILL'd pod host, no emergency
+        checkpoint) or stalls (hang: a real straggler for the cluster
+        telemetry to catch).  Single-process simulated cluster: the
+        fault names a simulated host and the elastic controller drains
+        and reshapes around it (without ``elastic=`` the fault degrades
+        to a preemption request — the restart path)."""
+        for kind in ("host_kill", "host_hang"):
+            fault = plan.fire(kind, step=gstep)
+            if fault is None:
+                continue
+            if process_count() > 1:
+                if int(fault.host) == process_index():
+                    if kind == "host_kill":
+                        logger.error(
+                            f"host_kill fault: host {fault.host} "
+                            f"hard-exiting at step {gstep} (no emergency "
+                            "checkpoint — the SIGKILL'd-host case)"
+                        )
+                        os._exit(113)
+                    logger.warning(
+                        f"host_hang fault: host {fault.host} stalling "
+                        f"{fault.secs}s at step {gstep}"
+                    )
+                    time.sleep(float(fault.secs))
+                continue
+            if self.elastic is None:
+                logger.warning(
+                    f"{kind} fault without Trainer(elastic=...): treating "
+                    "as a preemption (emergency checkpoint + clean exit)"
+                )
+                self._request_preemption(f"{kind} fault")
+                continue
+            self._request_reshape(kind, int(fault.host), step=gstep)
+
+    def _on_straggler_verdict(self, *, host: int, factor: float,
+                              step=None) -> None:
+        """Straggler verdict from ``telemetry/cluster.py``: past the
+        elastic reshape factor, request a drain+reshape around the
+        straggling host (pure alarm otherwise)."""
+        cfg = self.elastic
+        if cfg is None or cfg.straggler_reshape_factor is None:
+            return
+        if factor >= cfg.straggler_reshape_factor:
+            self._request_reshape(
+                "straggler", int(host), step=step,
+                detail={"factor": round(float(factor), 2)},
+            )
+
+    def _request_reshape(self, trigger: str, lost_host: int, step=None,
+                         detail: Optional[dict] = None) -> None:
+        """Queue one drain→reshape; consumed after the in-flight step."""
+        from ml_trainer_tpu.resilience.elastic import ReshapeRequest
+
+        if self.elastic is None or process_count() > 1:
+            return
+        if lost_host not in self._live_hosts:
+            logger.warning(
+                f"reshape request for host {lost_host} ignored: already "
+                f"removed (live hosts {self._live_hosts})"
+            )
+            return
+        if len(self._live_hosts) - 1 < self.elastic.min_hosts or (
+            len(self.reshapes) >= self.elastic.max_reshapes
+        ):
+            logger.warning(
+                f"reshape around host {lost_host} refused "
+                f"(live={len(self._live_hosts)}, "
+                f"min_hosts={self.elastic.min_hosts}, "
+                f"reshapes={len(self.reshapes)}/"
+                f"{self.elastic.max_reshapes}); treating as preemption"
+            )
+            self._request_preemption(f"{trigger} past elastic bounds")
+            return
+        if self._reshape_request is None and not self._preempt_requested:
+            self._reshape_request = ReshapeRequest(
+                trigger=trigger, lost_host=int(lost_host),
+                step=step, detail=detail or {},
+            )
+            logger.warning(
+                f"Elastic reshape requested ({trigger}, lost host "
+                f"{lost_host}): draining the in-flight step."
+            )
+
+    def _perform_reshape(self) -> None:
+        """Reshape the mesh around the lost host and keep training.
+
+        The drained cursor (``_reshape_pending``) marks where the epoch
+        stopped; this rebuilds the world — validated BEFORE any device
+        allocates — and re-enters the same epoch via the mid-epoch
+        resume machinery:
+
+        1. ``precheck_topology``: the analytic memory ledger prices the
+           target topology (structured ``TopologyError`` if it cannot
+           fit);
+        2. ``remap_state_shardings`` + ``validate_reshard``: per-leaf
+           target placement with the ZeRO-1 shape rule re-applied
+           (structured ``ReshardError`` naming the offending axis);
+        3. ONE whole-tree host fetch + ``place_tree`` placement;
+        4. batch/LR policy: ``'global'`` preserves the global batch
+           (math unchanged — the trajectory equals the uninterrupted
+           run's); ``'per_device'`` shrinks it by the survivor ratio
+           and rescales the LR linearly;
+        5. compiled steps, bucket plan, memory ledger rebuilt; compile
+           warmup re-opens for the reshaped programs.
+
+        The whole recovery is charged to the goodput ``reshape`` bucket
+        and recorded in ``history['reshapes']`` + a flight ``reshape``
+        event (old/new topology, trigger, steps-lost)."""
+        from ml_trainer_tpu.parallel import create_mesh, place_tree
+        from ml_trainer_tpu.resilience import elastic as el
+        from ml_trainer_tpu.telemetry import goodput
+
+        info, self._reshape_pending = self._reshape_pending, None
+        req = info["request"]
+        cfg = self.elastic
+        t0 = time.perf_counter()
+        with goodput.timed("reshape"):
+            old_topology = {a: int(s) for a, s in self.mesh.shape.items()}
+            old_devices = list(self.mesh.devices.flat)
+            groups = el.host_groups(old_devices, len(self._live_hosts))
+            pos = self._live_hosts.index(int(req.lost_host))
+            new_devices = [
+                d for gi, grp in enumerate(groups)
+                for d in grp if gi != pos
+            ]
+            new_shape = el.shrink_mesh_shape(
+                old_topology, len(old_devices), len(new_devices)
+            )
+            old_global = self.global_batch
+            new_global = old_global
+            if cfg.batch_policy == "per_device":
+                new_global = max(
+                    old_global * len(new_devices) // len(old_devices), 1
+                )
+            # (1) fit check from config alone — nothing has allocated.
+            el.precheck_topology(
+                self.model,
+                (new_global,) + tuple(self._batch_geometry[1:]),
+                mesh_shape=new_shape,
+                optimizer=self.optimizer_type,
+                sharding_rules=self._sharding_rules,
+                shard_opt_state=self._shard_opt_state,
+                dp_update=self.dp_update,
+                precision=(
+                    self.precision.label() if self.precision.active else None
+                ),
+                ema=self.ema_decay is not None,
+                grad_accum_steps=self.grad_accum_steps,
+                batch_dtype=self._batch_dtype,
+                capacity_bytes=cfg.capacity_bytes,
+                margin=cfg.margin,
+            )
+            new_mesh = create_mesh(new_shape, devices=new_devices)
+            # (2) per-leaf target placement, divisibility-validated.
+            new_shardings = el.remap_state_shardings(
+                self._state_shardings, self.state, new_mesh
+            )
+            el.validate_reshard(
+                self.state, new_shardings,
+                source_topology={"axes": old_topology},
+            )
+            # (3) one whole-tree fetch + placement.
+            host_state = jax.device_get(self.state)
+            self.mesh = new_mesh
+            self._batch_sharding = batch_sharding(new_mesh)
+            self._replicated = replicated(new_mesh)
+            self._data_parallel = int(
+                np.prod(
+                    [
+                        new_mesh.shape[a]
+                        for a in ("data", "fsdp")
+                        if a in new_mesh.axis_names
+                    ],
+                    initial=1,
+                )
+            )
+            self.state = place_tree(host_state, new_shardings)
+            self._state_shardings = new_shardings
+            self._live_hosts.pop(pos)
+            # (4) batch/LR policy.
+            lr_before = self._lr_scale
+            cursor = info.get("batches_done")
+            if cfg.batch_policy == "per_device" and new_global != old_global:
+                self._build_loaders(
+                    self._datasets[0], self._datasets[1], new_global,
+                    self.config,
+                )
+                self.steps_per_epoch = len(self.train_loader)
+                # Linear scaling rule, in reverse: the LR follows the
+                # global batch down so per-sample update magnitude holds.
+                self._lr_scale *= self.global_batch / old_global
+                if cursor is not None:
+                    # Re-express the cursor in the new batch geometry
+                    # (same shuffled sample order — the loader batches a
+                    # seed-determined permutation sequentially).
+                    cursor = (cursor * old_global) // self.global_batch
+            # (5) rebuild the compiled programs on the new mesh.
+            if self.dp_update == "sharded":
+                from ml_trainer_tpu.parallel import plan_grad_buckets
+
+                self._bucket_plan = plan_grad_buckets(
+                    self.state.params, int(self.mesh.shape["data"]),
+                    bucket_bytes=int(self.bucket_mb * 2 ** 20),
+                )
+            self._build_steps()
+            if self.telemetry:
+                from ml_trainer_tpu.telemetry import (
+                    compile_watch,
+                    memory as _memory,
+                )
+
+                # The reshaped programs legitimately compile: re-open
+                # warmup (closed again after the next full epoch) and
+                # re-publish the ledger for the new per-device split.
+                compile_watch.mark_cold()
+                self._mark_warm_after_epoch = True
+                self._memory_ledger = _memory.train_ledger(self)
+                self._memory_ledger.publish()
+        downtime = time.perf_counter() - t0
+        record = {
+            "step": int(info.get("step") or 0),
+            "epoch": int(info["epoch"]),
+            "trigger": req.trigger,
+            "lost_host": int(req.lost_host),
+            "old_topology": old_topology,
+            "new_topology": {a: int(s) for a, s in self.mesh.shape.items()},
+            "old_global_batch": int(old_global),
+            "global_batch": int(self.global_batch),
+            "lr_scale": float(self._lr_scale),
+            # The drain committed the in-flight step and the controller
+            # continues from LIVE state: a clean reshape loses zero
+            # steps (hard kills lose up to the save_every_steps cadence
+            # instead — the restart path).
+            "steps_lost": 0,
+            "downtime_secs": round(downtime, 3),
+        }
+        if req.detail:
+            record["detail"] = req.detail
+        self.reshapes.append(record)
+        self._flight.record("reshape", **record)
+        if self._telemetry is not None:
+            self._telemetry.registry.counter(
+                "train_reshapes_total",
+                "elastic mesh reshapes survived by this process",
+            ).inc()
+        if info.get("batches_done") is not None:
+            self._resume_mid = {
+                "epoch": int(info["epoch"]),
+                "batches_done": int(cursor),
+                "loss_sum": float(info["loss_sum"]),
+                "metric_sum": float(info["metric_sum"]),
+                "skipped_base": int(self._skipped_base),
+            }
+        logger.warning(
+            f"Elastic reshape: lost host {req.lost_host} ({req.trigger}); "
+            f"mesh {record['old_topology']} -> {record['new_topology']}, "
+            f"global batch {old_global} -> {self.global_batch}, lr scale "
+            f"{lr_before:.4g} -> {self._lr_scale:.4g}, downtime "
+            f"{downtime:.2f}s."
+        )
+
     @staticmethod
     def _poison_batch(x):
         """``nan_grad`` fault: NaN-fill a float batch so the compiled step
@@ -2600,6 +2980,10 @@ class Trainer:
         os.makedirs(ckpt_dir, exist_ok=True)
         info = dict(self._preempt_info or {})
         info["time"] = time.time()
+        # The topology that wrote the emergency checkpoint: a resume at
+        # a DIFFERENT shape (elastic restore) knows — and can report —
+        # what the world looked like when the preemption landed.
+        info["mesh"] = ckpt.state_mesh_topology(self.state)
         tmp = os.path.join(ckpt_dir, "PREEMPTED.json.tmp")
         with open(tmp, "w") as fp:
             json.dump(info, fp)
@@ -2620,6 +3004,16 @@ class Trainer:
             f"Clean preemption exit detected ({info}); resuming from the "
             "emergency checkpoint."
         )
+        saved_mesh = (info.get("mesh") or {}).get("axes")
+        current = ckpt.state_mesh_topology(self.state) if (
+            self.state is not None
+        ) else None
+        if saved_mesh and current and saved_mesh != current.get("axes"):
+            logger.info(
+                f"Topology changed across the preemption: saved on "
+                f"{saved_mesh}, resuming on {current.get('axes')} "
+                "(elastic restore reshards the checkpoint)."
+            )
         if info.get("time"):
             # Downtime attribution: the age of the marker is the gap the
             # preemption cost between exit and this resume — the
@@ -2793,6 +3187,7 @@ class Trainer:
         self.val_metrics = list(saved.get("val_metric", []))
         self.skipped_steps = list(saved.get("skipped_steps", []))
         self.rollbacks = int(saved.get("rollbacks", 0))
+        self.reshapes = list(saved.get("reshapes", []))
         done_epoch = int(scalars[0])
         self._lr_scale = float(scalars[1])
         if self._plateau is not None:

@@ -61,6 +61,16 @@ must report no finding absent from the committed
 new SPMD deadlock / precision / donation / lock-order findings are
 hard failures before any device runs.
 
+A seventh leg (``gate_elastic``, skip with ``--skip-elastic``) gates
+elastic training (ROADMAP #1): the drain→reshape→continue chaos run
+must finish with the uninterrupted trajectory, zero steps lost and a
+bit-exact-resumable history (hard invariants), the cross-process
+hard-kill restart must stay within the ``save_every_steps`` steps-lost
+cadence bound, and the time-to-recover rate ratchets against
+``docs/elastic_chaos_cpu.json`` / this machine's baseline (elastic
+threshold floored at 0.5 — wall-clock recovery breathes on shared
+containers).
+
 Exit non-zero = regression.  Threshold override:
 ``ML_TRAINER_TPU_BENCH_GATE_THRESHOLD`` (fraction, e.g. ``0.15``).
 """
@@ -647,6 +657,108 @@ def gate_goodput(threshold: float) -> dict:
     return out
 
 
+def committed_elastic_reference(repo: str = REPO):
+    """The committed elastic chaos artifact
+    (docs/elastic_chaos_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "elastic_chaos_cpu.json")
+    try:
+        return json.load(open(path))
+    except (OSError, ValueError):
+        return None
+
+
+def gate_elastic(threshold: float, backend: str, fp: str) -> dict:
+    """The elastic-training chaos gate (ROADMAP #1): re-runs
+    ``scripts/elastic_smoke.py`` in a subprocess (its phases need their
+    own processes for per-phase virtual device counts) and enforces
+
+    1. **Invariants** (hard): the in-process drain→reshape→continue leg
+       finishes with the uninterrupted trajectory, ZERO steps lost, and
+       a bit-exact-resumable history; the cross-process hard-kill leg
+       recovers with steps-lost bounded by the ``save_every_steps``
+       cadence;
+    2. **Time-to-recover ratchet**: the hard-kill restart's recovery
+       RATE (1 / wall-clock seconds) against the committed
+       ``docs/elastic_chaos_cpu.json`` and this machine's calibrated
+       baseline — wall-clock recovery on a shared CPU container
+       breathes, so the elastic threshold is floored at 0.5 (the
+       ratchet catches collapses, not scheduler noise).
+    """
+    import subprocess
+
+    script = os.path.join(REPO, "scripts", "elastic_smoke.py")
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True, text=True,
+            timeout=500, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "decided_by": "worker",
+                "error": "elastic_smoke.py timed out"}
+    line = next(
+        (ln for ln in proc.stdout.splitlines()
+         if ln.startswith("ELASTIC_SMOKE_RESULT ")), None,
+    )
+    if proc.returncode != 0 or line is None:
+        tail = (proc.stdout + proc.stderr).strip().splitlines()[-8:]
+        return {"ok": False, "decided_by": "invariants",
+                "error": "elastic_smoke failed: " + " | ".join(tail)}
+    result = json.loads(line[len("ELASTIC_SMOKE_RESULT "):])
+    ip, rs = result["in_process"], result.get("restart", {})
+    out = {
+        "trajectory_equal": ip["trajectory_equal"],
+        "bit_exact_resumable": ip["bit_exact_resumable"],
+        "steps_lost_clean_drain": ip["steps_lost"],
+        "reshape_downtime_secs": ip["reshape_downtime_secs"],
+        "steps_lost_hard_kill": rs.get("steps_lost"),
+        "steps_lost_bound": rs.get("steps_lost_bound"),
+        "time_to_recover_secs": rs.get("time_to_recover_secs"),
+        "threshold": threshold,
+    }
+    if not result["ok"] or not ip["trajectory_equal"] or (
+        not ip["bit_exact_resumable"] or ip["steps_lost"] != 0
+    ):
+        out.update(ok=False, decided_by="invariants",
+                   error=f"elastic invariants violated: {result}")
+        return out
+    if rs and rs["steps_lost"] > rs["steps_lost_bound"]:
+        out.update(
+            ok=False, decided_by="steps_lost_bound",
+            error=f"hard-kill lost {rs['steps_lost']} steps "
+            f"(> cadence bound {rs['steps_lost_bound']})",
+        )
+        return out
+    recover_secs = float(rs.get("time_to_recover_secs") or 0.0)
+    if recover_secs <= 0:
+        out.update(ok=True, decided_by="invariants",
+                   note="no restart timing; invariants only")
+        return out
+    committed = committed_elastic_reference()
+    committed_rate = None
+    if committed and committed.get("time_to_recover_secs"):
+        committed_rate = 1.0 / float(committed["time_to_recover_secs"])
+    elastic_key = f"{backend}_elastic"
+    baseline = load_baseline(elastic_key, fp)
+    decision = evaluate(
+        1.0 / recover_secs, committed_rate, baseline,
+        max(threshold, 0.5),
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            elastic_key, fp, max(1.0 / recover_secs, baseline or 0.0)
+        )
+    else:
+        out["error"] = (
+            f"time-to-recover {recover_secs}s regressed "
+            f">{max(threshold, 0.5) * 100:.0f}% vs recovery-rate "
+            f"baseline {baseline}"
+        )
+    return out
+
+
 def committed_lint_baseline(repo: str = REPO):
     """The committed graft-lint baseline artifact, or None."""
     path = os.path.join(repo, "docs", "graft_lint_baseline.json")
@@ -748,6 +860,8 @@ def main() -> int:
                         "recompile gate")
     parser.add_argument("--skip-lint", action="store_true",
                         help="skip the graft-lint static-analysis gate")
+    parser.add_argument("--skip-elastic", action="store_true",
+                        help="skip the elastic-training chaos gate")
     args = parser.parse_args()
 
     import jax
@@ -854,6 +968,21 @@ def main() -> int:
             f"{len(gp['configs'])} ledger configs agree, goodput "
             f"{gp['goodput_fraction']}, "
             f"{gp['post_warmup_compiles']} post-warmup compiles",
+            flush=True,
+        )
+    if not args.skip_elastic:
+        ela = gate_elastic(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_elastic": ela}), flush=True)
+        if not ela["ok"]:
+            print(f"BENCH_GATE ELASTIC FAIL: {ela.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE ELASTIC OK ({ela['decided_by']}): reshape "
+            f"downtime {ela['reshape_downtime_secs']}s, hard-kill lost "
+            f"{ela['steps_lost_hard_kill']} step(s) (bound "
+            f"{ela['steps_lost_bound']}), recovered in "
+            f"{ela['time_to_recover_secs']}s",
             flush=True,
         )
     if not args.skip_lint:

@@ -22,7 +22,12 @@
 # Poisson schedule through the real HTTP server — lifecycle latency
 # histograms + attainment/burn-rate exposition, nested request trace
 # spans, forced-preemption flight dump naming request ids with
-# timelines), and a bench
+# timelines), an elastic-training smoke leg (scripts/elastic_smoke.py
+# --quick: kill 1 of 2 simulated hosts mid-run; the same fit() drains,
+# reshapes 8 -> 4 devices and finishes with the uninterrupted
+# trajectory and a bit-exact-resumable history; the bench gate's
+# gate_elastic adds the cross-process hard-kill restart +
+# time-to-recover ratchet vs docs/elastic_chaos_cpu.json), and a bench
 # graft-lint static-analysis leg (scripts/graft_lint.py: jaxpr
 # contract checks over the traced train/decode/pipeline programs +
 # the AST concurrency/hygiene pack, hard-failed against the committed
@@ -81,6 +86,11 @@ echo "# serving-SLO smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 slo_rc=$?
 [ $slo_rc -ne 0 ] && echo "# slo smoke FAILED (rc=$slo_rc)"
+echo "# elastic-training smoke leg (--quick: in-process reshape only;"
+echo "# the bench gate's gate_elastic runs the full cross-process leg)"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py --quick
+elastic_rc=$?
+[ $elastic_rc -ne 0 ] && echo "# elastic smoke FAILED (rc=$elastic_rc)"
 echo "# graft-lint static-analysis leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/graft_lint.py
 lint_rc=$?
@@ -96,7 +106,7 @@ else
   ruff_rc=0
 fi
 echo "# bench regression gate"
-timeout -k 10 1500 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 1800 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -107,6 +117,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$pipeline_rc
 [ $rc -eq 0 ] && rc=$memory_rc
 [ $rc -eq 0 ] && rc=$slo_rc
+[ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc
 [ $rc -eq 0 ] && rc=$gate_rc

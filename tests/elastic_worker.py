@@ -7,22 +7,34 @@ match the one that died).  Global-batch semantics make the trajectory
 device-count-invariant, so the resumed run must continue the
 uninterrupted reference's losses.
 
-Usage: python elastic_worker.py <ndev> <phase> <workdir> <sharded01>
-  phase: full   — train 4 epochs from scratch
-         first  — train 2 epochs (leaves checkpoints behind)
-         resume — train to epoch 4 with fit(resume=True)
+Usage: python elastic_worker.py <ndev> <phase> <workdir> <flavor> [fault]
+  phase:  full      — train 4 epochs from scratch
+          first     — train 2 epochs (leaves checkpoints behind)
+          first_mid — train with ``fault`` injected (a mid-epoch preempt:
+                      emergency checkpoint + clean exit, asserted)
+          resume    — train to epoch 4 with fit(resume=True)
+  flavor: v2   — pure DP, host-0 full-tree checkpoints
+          v3   — pure DP + ZeRO-1 moments, per-host sharded checkpoints
+          fsdp — data×fsdp mesh with rule-sharded dense kernels
+                 (non-pure-DP: the reshard must stitch MODEL shards
+                 across different fsdp grids), v3 checkpoints
+  fault:  optional ``ML_TRAINER_TPU_FAULTS`` spec (first_mid phases);
+          implies step-granular checkpoints (save_every_steps=2)
 """
 
 import os
 import sys
 
-ndev, phase, workdir, sharded = (
-    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4] == "1"
+ndev, phase, workdir, flavor = (
+    int(sys.argv[1]), sys.argv[2], sys.argv[3], sys.argv[4]
 )
+fault = sys.argv[5] if len(sys.argv) > 5 else None
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + f" --xla_force_host_platform_device_count={ndev}"
 ).strip()
+if fault:
+    os.environ["ML_TRAINER_TPU_FAULTS"] = fault
 
 import jax  # noqa: E402
 
@@ -30,6 +42,7 @@ jax.config.update("jax_platforms", "cpu")
 assert jax.device_count() == ndev, jax.device_count()
 
 import numpy as np  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -40,14 +53,33 @@ datasets = (
     SyntheticCIFAR10(size=64, seed=0),
     SyntheticCIFAR10(size=32, seed=1),
 )
+kw = {}
+if flavor == "v3":
+    kw.update(shard_opt_state=True, sharded_checkpoint=True)
+elif flavor == "fsdp":
+    # Rule-sharded dense kernels over a genuine model axis: the elastic
+    # restore must re-stitch MODEL shards (not just replicas) onto a
+    # DIFFERENT fsdp grid (8 devices: fsdp=4; 4 devices: fsdp=2).
+    kw.update(
+        mesh_shape={"data": 2, "fsdp": ndev // 2},
+        sharding_rules=[(r"fc\d/kernel", P("fsdp"))],
+        sharded_checkpoint=True,
+    )
+elif flavor != "v2":
+    raise SystemExit(f"unknown flavor {flavor!r}")
+if fault:
+    kw.update(save_every_steps=2)
 epochs = 2 if phase == "first" else 4
 t = Trainer(
     MLModel(), datasets=datasets, epochs=epochs, batch_size=16,
     model_dir=workdir, is_parallel=True, backend="cpu", seed=11, lr=0.01,
-    optimizer="adam", metric=None,
-    shard_opt_state=sharded, sharded_checkpoint=sharded,
+    optimizer="adam", metric=None, **kw,
 )
 t.fit(resume=(phase == "resume"))
+if phase == "first_mid":
+    assert t.preempted, "injected preempt fault did not trip fit()"
+    marker = os.path.join(workdir, "checkpoints", "PREEMPTED.json")
+    assert os.path.exists(marker), "no clean-exit marker after preemption"
 assert all(np.isfinite(v) for v in t.train_losses)
 print(f"LOSSES {t.train_losses}", flush=True)
 print("WORKER_DONE", flush=True)
