@@ -567,7 +567,7 @@ def bench_serve_replay(n_requests=48, n_tenants=3, shared_frac=0.8,
 
 def bench_slo(rates=(40.0, 120.0, 360.0, 720.0), n_requests=36, seed=0,
               ttft_ms=50.0, tpot_ms=25.0, max_batch=8, page_size=16,
-              out_path=None):
+              out_path=None, target_url=None):
     """Open-loop SLO sweep (docs/observability.md "Serving SLO"): fixed
     Poisson arrival schedules at ``rates`` offered req/s drive the REAL
     HTTP server end to end (POST /v1/generate per request), and each
@@ -594,6 +594,12 @@ def bench_slo(rates=(40.0, 120.0, 360.0, 720.0), n_requests=36, seed=0,
       timelines (``SloTracker``), scoped to the timed window; the
       client-observed e2e and scheduling fidelity (send lag) ride
       alongside from the load generator.
+
+    ``target_url`` points the SAME schedules at an EXTERNAL target —
+    a single replica's front end or the disaggregated router's
+    (``bench.py --slo-url http://host:port``) — instead of building a
+    local server; rows then carry the client-side aggregation only
+    (no in-process timeline access).
     """
     from ml_trainer_tpu.models import get_model
     from ml_trainer_tpu.serving import (
@@ -628,6 +634,29 @@ def bench_slo(rates=(40.0, 120.0, 360.0, 720.0), n_requests=36, seed=0,
             float(rate), n_requests, model.vocab_size, tenants=load,
             seed=seed + i,
         )
+        if target_url is not None:
+            # External target (single replica or router): same recorded
+            # schedule, client-side truth only.
+            for _ in range(2):
+                run_open_loop(schedule, url=target_url, time_scale=0.0)
+            client = run_open_loop(schedule, url=target_url)
+            client.pop("per_request")
+            rows.append({
+                "offered_rps": float(rate),
+                "n_requests": n_requests,
+                "tokens_per_sec": client["tokens_per_sec"],
+                "n_errors": client["n_errors"],
+                "client": client,
+                "target_url": target_url,
+                "zero_recompiles": True,  # not observable externally
+            })
+            print(
+                f"# slo rate {rate:>6.1f} rps -> {target_url}: "
+                f"{client['tokens_per_sec']:,.1f} tokens/s, client e2e "
+                f"p99 {client['client_e2e_p99_ms']} ms",
+                flush=True,
+            )
+            continue
         with Server(model, variables, max_batch=max_batch,
                     max_queue=2 * n_requests, kv_page_size=page_size,
                     tenants=dict(tenant_cfg), slo=policy,
@@ -691,6 +720,203 @@ def bench_slo(rates=(40.0, 120.0, 360.0, 720.0), n_requests=36, seed=0,
         with open(out_path, "w", encoding="utf-8") as fp:
             json.dump(result, fp, indent=1)
         print(f"# slo artifact -> {out_path}", flush=True)
+    return result
+
+
+def bench_serve_disagg(n_requests=48, n_tenants=3, shared_frac=0.8,
+                       mean_interarrival=0.002, shared_len=160,
+                       page_size=16, max_batch=4, n_prefill=2,
+                       n_decode=2, seed=0, ttft_ms=1000.0,
+                       tpot_ms=1000.0, pool_factor=3, out_path=None):
+    """Disaggregated prefill/decode serving vs colocated at EQUAL
+    replica count (serving/router.py, docs/serving.md): the same
+    recorded 80%-shared-prefix trace, replayed open-loop at saturating
+    load through each topology's ROUTER HTTP front end.
+
+    * **Disaggregated**: ``n_prefill`` prefill + ``n_decode`` decode
+      replicas; every request prefills on an affinity-hashed prefill
+      replica, its KV migrates at page granularity to the least-loaded
+      decode replica.  Prefill slots turn over in one prefill's time,
+      so TTFT stops queueing behind other requests' decode residency —
+      the p99 TTFT win this artifact pins.
+    * **Colocated**: ``n_prefill + n_decode`` replicas serving both
+      roles behind the same router (no migration) — the equal-count
+      baseline.
+
+    Method guards (the bench_slo discipline): the trace is FIXED before
+    any run (seeded, round-tripped through the recorded-trace format so
+    both topologies replay identical bytes), each topology runs the
+    trace twice untimed (compiles incl. the kv export/import programs +
+    prefix caches to steady state) then once timed under
+    ``compile_watch.expect_no_compiles``; TTFT truth comes from the
+    ROUTER's request-lifecycle timelines scoped to the timed window;
+    and every request's full output ids are collected and compared
+    between topologies — zero byte-identity regressions is a hard
+    invariant of the artifact."""
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import Router, SloPolicy
+    from ml_trainer_tpu.serving.loadgen import (
+        ScheduledRequest, run_open_loop, schedule_from_trace,
+        schedule_to_records,
+    )
+    from ml_trainer_tpu.serving.slo import aggregate_timelines
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    model = get_model("gpt2_tiny", max_len=256)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(0, model.vocab_size, shared_len).astype(np.int32)
+        for _ in range(n_tenants)
+    ]
+    arrivals = np.cumsum(rng.exponential(mean_interarrival, n_requests))
+    trace = []
+    for i in range(n_requests):
+        t = int(rng.integers(0, n_tenants))
+        if rng.random() < shared_frac:
+            suffix = rng.integers(
+                0, model.vocab_size, int(rng.integers(4, 17))
+            ).astype(np.int32)
+            prompt = np.concatenate([prefixes[t], suffix])
+        else:
+            prompt = rng.integers(
+                0, model.vocab_size, int(rng.integers(16, 33))
+            ).astype(np.int32)
+        trace.append(ScheduledRequest(
+            arrival_s=float(arrivals[i]), tenant=f"tenant{t}",
+            prompt=prompt,
+            max_new_tokens=int(rng.choice([6, 24], p=[0.6, 0.4])),
+            # A quarter of the stream is multi-turn: sessions ride the
+            # recorded trace and exercise sticky decode placement.
+            session=f"sess{t}-{i % 4}" if rng.random() < 0.25 else None,
+        ))
+    # The recorded-trace round trip: both topologies replay these bytes.
+    schedule = schedule_from_trace(schedule_to_records(trace))
+    useful_tokens = sum(s.max_new_tokens for s in schedule)
+    policy = SloPolicy(ttft_ms=ttft_ms, tpot_ms=tpot_ms, target=0.9)
+    n_replicas = n_prefill + n_decode
+    compile_watch.install()
+
+    def run_topology(mode):
+        roles = (
+            ["prefill"] * n_prefill + ["decode"] * n_decode
+            if mode == "disagg" else ["both"] * n_replicas
+        )
+        router = Router.build(
+            model, variables, roles=roles, max_batch=max_batch,
+            kv_page_size=page_size, max_queue=2 * n_requests,
+            # Oversized pools: prefix-cache residency never evicts at
+            # steady state, so every pass sees the same hit lengths —
+            # the same continuation buckets — and the zero-recompile
+            # pin measures scheduling, not cache-churn noise.
+            kv_pages=pool_factor * max_batch * (256 // page_size) + 1,
+            router_kwargs={"slo": policy,
+                           "slo_timelines": 4 * n_requests},
+        )
+        with router:
+            host, port = router.serve_http(port=0)
+            url = f"http://{host}:{port}"
+            # Two untimed passes: compiles (prefill buckets, decode,
+            # kv export/import) + prefix caches to steady state.
+            for _ in range(2):
+                run_open_loop(schedule, url=url, time_scale=0.0)
+            timed_t0 = time.monotonic()
+            err = None
+            try:
+                with compile_watch.expect_no_compiles(f"disagg {mode}"):
+                    client = run_open_loop(
+                        schedule, url=url, collect_tokens=True
+                    )
+            except AssertionError as e:
+                err = str(e)
+                client = run_open_loop(
+                    schedule, url=url, collect_tokens=True
+                )
+            server_side = aggregate_timelines(
+                router.slo.timelines(since=timed_t0), policy
+            )
+            snap = router.snapshot()
+        outputs = [r.get("output") for r in client["per_request"]]
+        row = {
+            "mode": mode,
+            "replicas": len(roles),
+            "tokens_per_sec": client["tokens_per_sec"],
+            "makespan_s": client["makespan_s"],
+            "n_errors": client["n_errors"],
+            "ttft_p50_ms": server_side["ttft_ms"]["p50"],
+            "ttft_p99_ms": server_side["ttft_ms"]["p99"],
+            "tpot_p99_ms": server_side["tpot_ms"]["p99"],
+            "e2e_p99_ms": server_side["e2e_ms"]["p99"],
+            "attainment": server_side["attainment"],
+            "n_timelines": server_side["n_requests"],
+            "migrations": snap["migrations_total"],
+            "kv_migrated_bytes": snap["kv_migrated_bytes_total"],
+            "redistributes": snap["redistributes_total"],
+            "zero_recompiles": err is None,
+        }
+        if err is not None:
+            row["recompile_error"] = err
+        print(
+            f"# serve disagg [{mode:>9}]: {row['tokens_per_sec']:,.1f} "
+            f"tokens/s, TTFT p50 {row['ttft_p50_ms']} ms / p99 "
+            f"{row['ttft_p99_ms']} ms, {row['migrations']} migration(s)"
+            + ("" if err is None else "  [RECOMPILED]"),
+            flush=True,
+        )
+        return row, outputs
+
+    disagg, disagg_outs = run_topology("disagg")
+    coloc, coloc_outs = run_topology("colocated")
+    identical = (
+        all(o is not None for o in disagg_outs + coloc_outs)
+        and all(a == b for a, b in zip(disagg_outs, coloc_outs))
+    )
+    ratio = (
+        round(disagg["ttft_p99_ms"] / coloc["ttft_p99_ms"], 3)
+        if coloc["ttft_p99_ms"] else None
+    )
+    result = {
+        "disagg": disagg,
+        "colocated": coloc,
+        "ttft_p99_ratio": ratio,
+        "ttft_win": bool(ratio is not None and ratio < 1.0),
+        "byte_identical": identical,
+        "zero_recompiles": bool(
+            disagg["zero_recompiles"] and coloc["zero_recompiles"]
+        ),
+        "n_requests": n_requests,
+        "n_tenants": n_tenants,
+        "shared_frac": shared_frac,
+        "shared_len": shared_len,
+        "page_size": page_size,
+        "max_batch": max_batch,
+        "n_prefill": n_prefill,
+        "n_decode": n_decode,
+        "useful_tokens": useful_tokens,
+        "seed": seed,
+        "backend": jax.default_backend(),
+    }
+    if not identical:
+        result["error"] = "disaggregated output diverged from colocated"
+    elif not result["zero_recompiles"]:
+        result["error"] = "compiles observed during a timed pass"
+    elif disagg["n_errors"] or coloc["n_errors"]:
+        result["error"] = (
+            f"client errors: disagg {disagg['n_errors']}, colocated "
+            f"{coloc['n_errors']}"
+        )
+    elif not result["ttft_win"]:
+        result["error"] = (
+            f"disaggregated p99 TTFT did not beat colocated "
+            f"(ratio {ratio})"
+        )
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# serve disagg artifact -> {out_path}", flush=True)
     return result
 
 
@@ -1847,6 +2073,20 @@ def main():
                         "wait/e2e p50+p99 with SLO attainment + burn rate "
                         "per rate, zero recompiles pinned; writes "
                         "docs/serving_slo_cpu.json (gpt2_tiny; CPU-safe)")
+    parser.add_argument("--slo-url", default=None, metavar="URL",
+                        help="point the --slo sweep's schedules at an "
+                        "EXTERNAL target URL (a single replica's front "
+                        "end or the disaggregated router's) instead of "
+                        "building a local server; no artifact written")
+    parser.add_argument("--serve-disagg", action="store_true",
+                        help="run only the disaggregated-vs-colocated "
+                        "router comparison: the same recorded 80%%-"
+                        "shared-prefix trace open-loop at saturating "
+                        "load through a 2-prefill+2-decode router with "
+                        "page-granular KV migration vs 4 colocated "
+                        "replicas; byte identity + zero recompiles "
+                        "pinned; writes docs/serving_disagg_cpu.json "
+                        "(gpt2_tiny; CPU-safe)")
     parser.add_argument("--mixed", action="store_true",
                         help="run only the mixed-precision / sharded-update "
                         "matrix: {fp32,bf16} x {fused-psum, bucketed "
@@ -1971,14 +2211,31 @@ def main():
     if args.slo:
         # Open-loop capacity-vs-SLO sweep through the real HTTP server;
         # the artifact is what scripts/bench_gate.py gate_slo ratchets.
+        # --slo-url redirects the same schedules at an external target
+        # (router or replica) with client-side truth, no artifact.
+        import os as _os
+
+        out = None if args.slo_url else _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "serving_slo_cpu.json",
+        )
+        result = bench_slo(out_path=out, target_url=args.slo_url)
+        print(json.dumps({"slo": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.serve_disagg:
+        # Disaggregated vs colocated router at equal replica count; the
+        # artifact is the acceptance evidence for the router subsystem
+        # and feeds scripts/bench_gate.py gate_disagg.
         import os as _os
 
         out = _os.path.join(
             _os.path.dirname(_os.path.abspath(__file__)),
-            "docs", "serving_slo_cpu.json",
+            "docs", "serving_disagg_cpu.json",
         )
-        result = bench_slo(out_path=out)
-        print(json.dumps({"slo": result}))
+        result = bench_serve_disagg(out_path=out)
+        print(json.dumps({"serve_disagg": result}))
         if result.get("error"):
             sys.exit(1)
         return

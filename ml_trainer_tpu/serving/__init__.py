@@ -13,6 +13,16 @@ cache + radix prefix cache + multi-tenant scheduler with
     stream = server.submit(prompt_ids, max_new_tokens=64, tenant="pro")
     for token in stream: ...          # streamed
     full = server.complete(prompt_ids, 64)   # blocking
+
+Scale out with the disaggregated prefill/decode router (router.py +
+transfer.py, docs/serving.md "Disaggregated serving"):
+
+    from ml_trainer_tpu.serving import Router
+
+    router = Router.build(model, variables,
+                          roles=["prefill", "decode", "decode"],
+                          kv_page_size=16)
+    out = router.complete(prompt_ids, 64, session="chat-1")
 """
 
 from ml_trainer_tpu.serving.api import Server, TokenStream
@@ -31,13 +41,27 @@ from ml_trainer_tpu.serving.scheduler import (
 )
 from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
 from ml_trainer_tpu.serving.loadgen import (
+    ScheduledRequest,
     TenantLoad,
     poisson_schedule,
     run_open_loop,
     schedule_from_trace,
+    schedule_to_records,
+)
+from ml_trainer_tpu.serving.router import Router
+from ml_trainer_tpu.serving.transfer import (
+    KVSlotExport,
+    export_kv_slot,
+    import_kv_slot,
 )
 
 __all__ = [
+    "Router",
+    "KVSlotExport",
+    "export_kv_slot",
+    "import_kv_slot",
+    "ScheduledRequest",
+    "schedule_to_records",
     "SloPolicy",
     "SloTracker",
     "TenantLoad",

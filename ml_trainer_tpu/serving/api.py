@@ -24,6 +24,16 @@ driving the admit/step loop.  Callers interact through:
   window over the next K decode steps (telemetry/spans.py).
   Backpressure maps to HTTP 429, deadlines to 504.
 
+Multi-replica surface (serving/router.py, docs/serving.md
+"Disaggregated serving"): ``role`` labels the replica for the router
+(advertised on ``/healthz`` with queue depth, free KV pages and active
+slots — the placement signals), ``submit_request`` enqueues a
+pre-built request (resume prefixes, migration sinks), and ``adopt``
+accepts a KV migration exported by another replica's prefill
+(serving/transfer.py) — imported bit-for-bit into a free slot by the
+loop thread, falling back to requeue-and-reprefill under page
+pressure.
+
 Failure contract (docs/resilience.md): clients NEVER hang on a dead
 engine.  A watchdog thread monitors the loop's heartbeat; a decode step
 that wedges past ``watchdog_timeout`` (or an engine thread that dies)
@@ -35,6 +45,7 @@ finish what's in flight, then ``close()``.
 
 from __future__ import annotations
 
+import collections
 import json
 import threading
 import time
@@ -142,7 +153,8 @@ class Server:
                  tenants: Optional[dict] = None,
                  max_preemptions: int = 8,
                  slo: Optional[SloPolicy] = None,
-                 slo_timelines: int = 64):
+                 slo_timelines: int = 64,
+                 role: str = "both"):
         """``watchdog_timeout``: seconds the engine loop may go without a
         heartbeat WHILE work is pending before the watchdog declares it
         wedged — fails every in-flight/queued request with a structured
@@ -173,7 +185,18 @@ class Server:
         the always-on :class:`SloTracker` judges finished requests
         against (``server.slo`` — attainment/burn-rate on ``/metrics``
         and the ``/slo`` endpoint); ``slo_timelines`` bounds the
-        last-N request-timeline ring attached to flight dumps."""
+        last-N request-timeline ring attached to flight dumps.
+
+        ``role`` labels this replica for the disaggregated router
+        (serving/router.py): ``"prefill"``, ``"decode"`` or ``"both"``
+        (the default — a standalone server serves everything).  The
+        role is advertised on ``/healthz`` and is ROUTING POLICY only;
+        the engine itself can always do both."""
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(
+                f"role must be 'prefill', 'decode' or 'both', got {role!r}"
+            )
+        self.role = role
         self.metrics = metrics if metrics is not None else ServingMetrics()
         self.slo = SloTracker(
             policy=slo, metrics=self.metrics, keep_timelines=slo_timelines,
@@ -205,6 +228,11 @@ class Server:
         self._health_lock = threading.Lock()
         self._last_beat = time.monotonic()
         self._admitting_req: Optional[Request] = None
+        # KV adoptions landing from another replica's prefill (the
+        # router's migration hand-off): (request, KVSlotExport) pairs
+        # drained by the loop thread.  Plain deque — single consumer
+        # (the loop), producers only append; both ends are atomic.
+        self._adoptions: collections.deque = collections.deque()
         self._httpd = None
         self._http_thread = None
         self._thread = threading.Thread(
@@ -239,17 +267,6 @@ class Server:
         wedged/dead, and ``ValueError`` on a request the engine could
         never serve.  ``tenant``/``priority`` feed the multi-tenant
         scheduler (higher priority admits first within a tenant)."""
-        if self._stopping:
-            raise RuntimeError("server is closed")
-        if not self.healthy:
-            raise EngineUnhealthy(
-                self._unhealthy_reason or "serving engine unhealthy"
-            )
-        if self._draining:
-            raise AdmissionError(
-                "server is draining: admission stopped, in-flight "
-                "requests are finishing"
-            )
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token array")
@@ -285,6 +302,27 @@ class Server:
             eos_token_id=eos_token_id, deadline=deadline,
             tenant=tenant, priority=int(priority),
         )
+        self.submit_request(req)
+        return TokenStream(req, prompt)
+
+    def submit_request(self, req: Request) -> None:
+        """Enqueue a pre-built :class:`Request` (thread-safe) — the
+        router's shadow-submission surface: the request may carry
+        committed ``tokens`` (a resume / redistribution continues from
+        them as a prefix) and a ``migration_sink`` (prefill-and-export
+        instead of decoding in place).  The caller validated the
+        request shape; this enforces only server state."""
+        if self._stopping:
+            raise RuntimeError("server is closed")
+        if not self.healthy:
+            raise EngineUnhealthy(
+                self._unhealthy_reason or "serving engine unhealthy"
+            )
+        if self._draining:
+            raise AdmissionError(
+                "server is draining: admission stopped, in-flight "
+                "requests are finishing"
+            )
         # Observer installed BEFORE the enqueue so every terminal path —
         # including queued-expiry inside the scheduler — lands in the
         # SLO accounting; a rejected submit never enqueues, so its
@@ -293,7 +331,32 @@ class Server:
         self.scheduler.submit(req)
         self.slo.track(req)
         self._wake.set()
-        return TokenStream(req, prompt)
+
+    def adopt(self, req: Request, export) -> None:
+        """Accept a KV migration (thread-safe): ``req`` was prefilled on
+        another replica and ``export`` is its slot's page payload
+        (serving/transfer.py).  The loop thread imports it into a free
+        slot bit-for-bit and decodes from there; if the pool cannot
+        hold the chain the request falls back to requeue-and-reprefill
+        from its committed tokens.  Raises ``EngineUnhealthy`` /
+        ``RuntimeError`` when this replica cannot take work."""
+        if self._stopping:
+            raise RuntimeError("server is closed")
+        if not self.healthy:
+            raise EngineUnhealthy(
+                self._unhealthy_reason or "serving engine unhealthy"
+            )
+        if self._draining:
+            raise AdmissionError(
+                "server is draining: admission stopped, in-flight "
+                "requests are finishing"
+            )
+        # This replica's tracker owns the request's lifecycle from here
+        # (the prefill replica forgot it at export).
+        req.observer = self.slo.observe
+        self.slo.track(req)
+        self._adoptions.append((req, export))
+        self._wake.set()
 
     def complete(self, prompt, max_new_tokens: int,
                  timeout: Optional[float] = None, **kwargs) -> np.ndarray:
@@ -325,15 +388,31 @@ class Server:
         )
 
     def health(self) -> dict:
-        """Structured health snapshot (the ``/healthz`` payload)."""
+        """Structured health snapshot (the ``/healthz`` payload).  The
+        router places requests on these fields — ``role``,
+        ``queue_depth``, ``kv_pages_free``, ``active_slots`` — instead
+        of round-robin; the shape is pinned by a golden test in
+        tests/test_serving.py."""
+        engine = self.engine
         return {
             "ok": self.healthy and not self._draining and not self._stopping,
             "healthy": self.healthy,
             "draining": self._draining,
             "closed": self._stopping,
             "reason": self._unhealthy_reason,
-            "active_requests": self.engine.active_count(),
+            "role": self.role,
+            "active_requests": engine.active_count(),
+            "active_slots": engine.active_count(),
+            "max_slots": engine.max_batch,
             "queued_requests": self.scheduler.queue_depth(),
+            "queue_depth": self.scheduler.queue_depth(),
+            "adoptions_pending": len(self._adoptions),
+            "kv_pages_free": (
+                engine.pool.free_count() if engine.paged else None
+            ),
+            "kv_pages_total": (
+                engine.kv_pages - 1 if engine.paged else None
+            ),
         }
 
     def close(self) -> None:
@@ -382,6 +461,13 @@ class Server:
                     sched.release(slot)
                 except ValueError:
                     pass
+        while self._adoptions:
+            try:
+                req, _ = self._adoptions.popleft()
+            except IndexError:
+                break
+            if req.state == "active" or req.state == "queued":
+                req.finish("error", msg)
         for req in sched.drain_pending():
             req.finish("error", msg)
         for req in engine.drain_preempted():
@@ -430,6 +516,7 @@ class Server:
                 self.engine.active_count() > 0
                 or self.scheduler.queue_depth() > 0
                 or self._admitting_req is not None
+                or len(self._adoptions) > 0
             )
             stale = time.monotonic() - self._last_beat
             if busy and stale > self._watchdog_timeout:
@@ -461,12 +548,85 @@ class Server:
             )
             self._fail_all(msg, release_slots=True)
 
+    def _drain_adoptions(self) -> bool:
+        """Import queued KV adoptions into free slots (loop thread only).
+        An adoption the pool cannot hold falls back to the ordinary
+        requeue path — admission re-prefills from the request's
+        committed tokens, the same resume preemption uses."""
+        engine, sched = self.engine, self.scheduler
+        progressed = False
+        for _ in range(len(self._adoptions)):
+            try:
+                req, export = self._adoptions.popleft()
+            except IndexError:
+                break
+            if req.expired():
+                req.finish(
+                    "expired",
+                    f"deadline ({req.deadline}s) passed awaiting adoption",
+                )
+                self.metrics.record_expiry()
+                progressed = True
+                continue
+            slot = sched.acquire_direct(req)
+            if slot is None:
+                # No free slot right now: park it at the head so the
+                # next free slot goes to the oldest adoption.
+                self._adoptions.appendleft((req, export))
+                break
+            # Tracked like a prefill admission: a crash mid-import is
+            # visible to the watchdog/error handler (the request is not
+            # in engine._active yet) and fails its stream instead of
+            # hanging the client.
+            self._admitting_req = req
+            status = engine.import_slot(req, slot, export)
+            self._admitting_req = None
+            if status == "no_memory":
+                sched.release(slot)
+                req.mark("adopt_no_memory", kv_pages_free=(
+                    engine.pool.free_count() if engine.paged else None
+                ))
+                sched.requeue(req)
+            else:
+                req.mark("adopted", slot=slot)
+            progressed = True
+        return progressed
+
+    def _export_for_migration(self, req: Request, slot: int) -> None:
+        """Prefill-and-export hand-off (loop thread only): the request
+        just prefilled into ``slot`` and carries a ``migration_sink`` —
+        ship its KV to the router instead of decoding here.  The slot's
+        pages release with the usual prefix-cache donation, so the
+        prompt stays hot on this prefill replica for affinity-routed
+        followers."""
+        engine, sched = self.engine, self.scheduler
+        export = engine.export_slot(slot)
+        engine._active.pop(slot, None)
+        engine._release_slot_pages(slot, req, donate=True)
+        sched.release(slot)
+        # The decode replica's tracker takes over at adopt().
+        self.slo.forget(req)
+        req.mark(
+            "kv_exported", pages=export.n_pages, kv_bytes=export.nbytes(),
+        )
+        sink, req.migration_sink = req.migration_sink, None
+        try:
+            sink(req, export)
+        except Exception as e:  # noqa: BLE001 — the sink is router code
+            req.finish(
+                "error",
+                f"kv migration sink failed: {type(e).__name__}: {e}",
+            )
+
     def _loop_inner(self) -> None:
         engine, sched = self.engine, self.scheduler
         while not self._stopping and self.healthy:
             self._last_beat = time.monotonic()
             try:
-                progressed = False
+                # Adoptions first: they already spent a prefill on
+                # another replica — making them wait behind fresh
+                # admissions would waste that work under load.
+                progressed = self._drain_adoptions()
                 while engine.free_capacity() > 0:
                     got = sched.acquire()
                     if got is None:
@@ -489,6 +649,8 @@ class Server:
                         break
                     if status == "finished":
                         sched.release(slot)
+                    elif status == "active" and req.migration_sink is not None:
+                        self._export_for_migration(req, slot)
                 if engine.active_count():
                     for slot in engine.step():
                         sched.release(slot)

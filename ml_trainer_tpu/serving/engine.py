@@ -660,6 +660,25 @@ class SlotDecodeEngine:
             self._push_kv_metrics()
         return freed
 
+    # -- KV migration (serving/transfer.py; disaggregated serving) -------
+
+    def export_slot(self, slot: int):
+        """Export ``slot``'s page chain + continuation state (the
+        migration unit the router ships to a decode replica).  Read-only
+        — the caller releases the slot afterwards if it migrates."""
+        from ml_trainer_tpu.serving.transfer import export_kv_slot
+
+        return export_kv_slot(self, slot)
+
+    def import_slot(self, req: Request, slot: int, export) -> str:
+        """Scatter an exported chain into ``slot`` bit-for-bit and
+        register ``req`` as active; returns ``"active"`` or
+        ``"no_memory"`` (target pool full — caller requeues ``req``,
+        which resumes via the ordinary preempt-resume prefill)."""
+        from ml_trainer_tpu.serving.transfer import import_kv_slot
+
+        return import_kv_slot(self, req, slot, export)
+
     # -- serving ---------------------------------------------------------
 
     def free_capacity(self) -> int:

@@ -76,12 +76,16 @@ class TenantLoad:
 
 @dataclasses.dataclass
 class ScheduledRequest:
-    """One arrival in the fixed open-loop schedule."""
+    """One arrival in the fixed open-loop schedule.  ``session`` rides
+    through to the router's sticky decode placement (ignored by a
+    single-replica server — the field exists so ONE recorded trace can
+    drive both topologies)."""
 
     arrival_s: float           # absolute offset from the run's t0
     tenant: str
     prompt: np.ndarray         # int32 token ids
     max_new_tokens: int
+    session: Optional[str] = None
 
 
 def poisson_schedule(rate_rps: float, n_requests: int, vocab_size: int,
@@ -135,6 +139,7 @@ def schedule_to_records(schedule: Sequence[ScheduledRequest]) -> list:
             "tenant": s.tenant,
             "prompt": [int(t) for t in s.prompt],
             "max_new_tokens": s.max_new_tokens,
+            **({"session": s.session} if s.session else {}),
         }
         for s in schedule
     ]
@@ -155,6 +160,7 @@ def schedule_from_trace(records) -> List[ScheduledRequest]:
             tenant=str(r.get("tenant", "default")),
             prompt=np.asarray(r["prompt"], np.int32),
             max_new_tokens=int(r["max_new_tokens"]),
+            session=r.get("session"),
         ))
     out.sort(key=lambda s: s.arrival_s)
     return out
@@ -170,18 +176,25 @@ def _percentile_ms(sorted_s: list, q: float) -> float:
 def run_open_loop(schedule: Sequence[ScheduledRequest],
                   url: Optional[str] = None, server=None,
                   timeout: float = 300.0,
-                  time_scale: float = 1.0) -> dict:
+                  time_scale: float = 1.0,
+                  collect_tokens: bool = False) -> dict:
     """Fire ``schedule`` open-loop at the real server and report.
 
-    ``url`` drives the HTTP front end (POST ``{url}/v1/generate`` per
-    request — the full production path: JSON parse, admission, engine,
-    response); ``server`` drives the in-process API (tests).  Exactly
-    one must be given.  A dispatcher thread sleeps to each ABSOLUTE
-    scheduled arrival and hands the request to its own worker thread —
-    completions never gate arrivals (no coordinated omission), and the
-    report's ``send_lag_ms`` records how faithfully the schedule fired.
-    ``time_scale`` stretches (>1) or compresses (<1) the schedule's
-    arrival offsets without touching its content."""
+    ``url`` is the explicit TARGET — a single replica's front end or
+    the disaggregated router's, interchangeably (POST
+    ``{url}/v1/generate`` per request: the full production path — JSON
+    parse, admission/routing, engine, response), which is what lets
+    ``bench.py --slo``/``--serve-disagg`` drive both topologies with
+    the same recorded trace; ``server`` drives the in-process API
+    (tests).  Exactly one must be given.  A dispatcher thread sleeps to
+    each ABSOLUTE scheduled arrival and hands the request to its own
+    worker thread — completions never gate arrivals (no coordinated
+    omission), and the report's ``send_lag_ms`` records how faithfully
+    the schedule fired.  ``time_scale`` stretches (>1) or compresses
+    (<1) the schedule's arrival offsets without touching its content.
+    ``collect_tokens`` keeps each request's full output ids on its
+    per-request row — the byte-identity evidence a topology comparison
+    needs."""
     if (url is None) == (server is None):
         raise ValueError("exactly one of url/server must be given")
     results = [None] * len(schedule)
@@ -196,11 +209,14 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
         }
         try:
             if url is not None:
-                body = json.dumps({
+                payload = {
                     "prompt": [int(t) for t in s.prompt],
                     "max_new_tokens": s.max_new_tokens,
                     "tenant": s.tenant,
-                }).encode()
+                }
+                if s.session:
+                    payload["session"] = s.session
+                body = json.dumps(payload).encode()
                 req = urllib.request.Request(
                     f"{url}/v1/generate", data=body,
                     headers={"Content-Type": "application/json"},
@@ -208,12 +224,16 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     out = json.loads(resp.read())
                 row["tokens"] = len(out["tokens"]) - s.prompt.size
+                if collect_tokens:
+                    row["output"] = [int(t) for t in out["tokens"]]
             else:
                 out = server.complete(
                     s.prompt, s.max_new_tokens, tenant=s.tenant,
                     timeout=timeout,
                 )
                 row["tokens"] = int(np.asarray(out).size - s.prompt.size)
+                if collect_tokens:
+                    row["output"] = [int(t) for t in np.asarray(out)]
             row["ok"] = True
         except Exception as e:  # the harness reports failures, it
             row["error"] = f"{type(e).__name__}: {e}"  # never dies on one

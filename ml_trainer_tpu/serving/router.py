@@ -1,0 +1,918 @@
+"""Disaggregated prefill/decode serving: a router over N engine replicas.
+
+At production traffic, prefill (compute-bound, bursty) and decode
+(memory-bandwidth-bound, steady) fight for the same chips; the
+Gemma-on-TPU serving study (PAPERS.md, arXiv 2605.25645) argues the
+economics favor splitting them onto role-specialized replicas.  This
+module is that split, simulated multi-replica on CPU (each replica is a
+full :class:`~ml_trainer_tpu.serving.api.Server` with its own engine,
+scheduler, worker thread and optional HTTP front end — the in-process
+analog of the mp_worker cluster harness):
+
+* **Roles.**  Every replica advertises ``role`` (``prefill`` /
+  ``decode`` / ``both``) on its ``/healthz``.  In DISAGGREGATED mode a
+  request prefills on a prefill replica — whose slots turn over in one
+  prefill's time, so TTFT stops queueing behind other requests' decode
+  residency — then its KV migrates at page granularity
+  (serving/transfer.py) to a decode replica that carries the stream to
+  completion.  In COLOCATED mode (every replica ``both``) the same
+  router serves the same traffic with no migration, which is what makes
+  ``bench.py --serve-disagg`` an equal-replica-count comparison.
+
+* **Placement.**  Prefill placement is tenant-affinity-aware:
+  consistent hashing (a vnode ring) on ``tenant + the prompt's first
+  KV block``, so requests sharing a system prompt land on the same
+  prefill replica and its radix prefix cache keeps its hit rate after
+  the split.  Decode placement is least-loaded over live ``/healthz``
+  data (``queue_depth``, ``active_slots``, ``kv_pages_free``), with
+  SESSION STICKINESS: a ``session`` key pins a multi-turn stream to one
+  decode replica until that replica dies.
+
+* **Migration.**  The prefill replica emits the request's first token,
+  exports the slot's refcounted pages + page-table row (bit-for-bit,
+  trash-padded to a static shape so migration never mints compiles),
+  releases the slot with the usual prefix-cache donation, and the
+  router adopts the request into the decode replica — which scatters
+  the pages in, re-donates the migrated blocks to ITS prefix cache, and
+  continues the stream byte-identically (tests/test_router.py pins
+  greedy and spec_k continuations against never-migrated runs).
+
+* **Failure semantics.**  A health poller consumes every replica's
+  ``/healthz``; a replica that dies (watchdog trip, engine-thread
+  death, kill) fails its in-flight requests with structured errors,
+  and the router REDISTRIBUTES them: each request resubmits on a
+  surviving replica with its committed tokens as a resumable prefix —
+  exactly the preemption-requeue resume, so redistributed streams stay
+  byte-identical.  Requests that exhaust ``max_redistributes`` (and
+  engine-side ``max_preemptions`` give-ups) surface as structured
+  client errors; nothing ever hangs.
+
+Telemetry rides the process registry: ``router_requests_total{role=,
+replica=}``, ``router_kv_migrated_bytes_total``,
+``router_replica_healthy{replica=}``, ``router_migrations_total``,
+``router_redistributes_total``, plus per-replica SLO attainment
+(``router_replica_slo_attainment{slo=,replica=}``) through each
+replica's existing SloTracker, and the router's own request-level SLO
+accounting on ``/slo``.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+import json
+import queue as _queue
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ml_trainer_tpu.serving import transfer
+from ml_trainer_tpu.serving.api import Server, TokenStream
+from ml_trainer_tpu.serving.scheduler import (
+    AdmissionError,
+    EngineUnhealthy,
+    Request,
+    _DONE,
+)
+from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
+from ml_trainer_tpu.utils.logging import get_logger
+
+# Stream sentinel kind the migration sink pushes between tokens: the
+# request's pump adopts the export into the decode replica when it
+# drains this item (tokens are plain ints, _DONE is ("done", None)).
+_MIGRATE = "__kv_migrate__"
+
+
+class Replica:
+    """One engine replica behind the router: the in-process ``Server``
+    plus its routing state (role, last health payload, liveness)."""
+
+    def __init__(self, name: str, server: Server,
+                 url: Optional[str] = None):
+        self.name = name
+        self.server = server
+        self.url = url
+        self.role = server.role
+        self.healthy = True
+        self.last_health: dict = {}
+        # Placements since the last health refresh: the health payload
+        # is a quarter-second stale under burst arrivals, so without
+        # this every tie lands on the same replica until the next poll.
+        self.pending = 0
+
+    def fetch_health(self, timeout: float = 2.0) -> dict:
+        """The replica's ``/healthz`` payload — over HTTP when the
+        replica exposes a front end (a 503 still carries the payload),
+        else the in-process snapshot."""
+        if self.url:
+            try:
+                with urllib.request.urlopen(
+                    f"{self.url}/healthz", timeout=timeout
+                ) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                try:
+                    return json.loads(e.read())
+                except Exception:
+                    return {"ok": False, "healthy": False,
+                            "reason": f"healthz HTTP {e.code}"}
+            except Exception as e:
+                return {"ok": False, "healthy": False,
+                        "reason": f"healthz unreachable: {e}"}
+        return self.server.health()
+
+    def placeable(self) -> bool:
+        return self.healthy
+
+    def load_score(self) -> tuple:
+        """Least-loaded ordering key from the last health payload:
+        occupied slots + queued + pending adoptions first, freest KV
+        pool as the tie-break, name for determinism."""
+        h = self.last_health or {}
+        depth = (
+            int(h.get("active_slots") or 0)
+            + int(h.get("queue_depth") or 0)
+            + int(h.get("adoptions_pending") or 0)
+            + self.pending
+        )
+        return (depth, -(int(h.get("kv_pages_free") or 0)), self.name)
+
+
+class _HashRing:
+    """Consistent hashing with virtual nodes (sha1): the affinity key
+    maps to the first clockwise vnode whose replica is alive, so a
+    replica loss only remaps its own arc."""
+
+    def __init__(self, names: Sequence[str], vnodes: int = 64):
+        self._points: List[Tuple[int, str]] = sorted(
+            (self._hash(f"{name}#{i}".encode()), name)
+            for name in names for i in range(vnodes)
+        )
+
+    @staticmethod
+    def _hash(key: bytes) -> int:
+        return int(hashlib.sha1(key).hexdigest()[:16], 16)
+
+    def place(self, key: bytes, alive) -> Optional[str]:
+        if not self._points:
+            return None
+        h = self._hash(key)
+        start = bisect.bisect_right(self._points, (h, ""))
+        n = len(self._points)
+        for i in range(n):
+            name = self._points[(start + i) % n][1]
+            if name in alive:
+                return name
+        return None
+
+
+class RouterMetrics:
+    """Thread-safe router counters (published as ``router_*`` series)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total: Dict[Tuple[str, str], int] = {}
+        self.migrations_total = 0
+        self.kv_migrated_bytes_total = 0
+        self.redistributes_total = 0
+        self.errors_total = 0
+        self.replica_healthy: Dict[str, int] = {}
+
+    def record_request(self, replica: str, role: str) -> None:
+        with self._lock:
+            key = (role, replica)
+            self.requests_total[key] = self.requests_total.get(key, 0) + 1
+
+    def record_migration(self, nbytes: int) -> None:
+        with self._lock:
+            self.migrations_total += 1
+            self.kv_migrated_bytes_total += int(nbytes)
+
+    def record_redistribute(self) -> None:
+        with self._lock:
+            self.redistributes_total += 1
+
+    def record_error(self) -> None:
+        with self._lock:
+            self.errors_total += 1
+
+    def set_replica_health(self, name: str, ok: bool) -> None:
+        with self._lock:
+            self.replica_healthy[name] = int(ok)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests_total": {
+                    f"{role}/{rep}": n
+                    for (role, rep), n in sorted(self.requests_total.items())
+                },
+                "migrations_total": self.migrations_total,
+                "kv_migrated_bytes_total": self.kv_migrated_bytes_total,
+                "redistributes_total": self.redistributes_total,
+                "errors_total": self.errors_total,
+                "replica_healthy": dict(sorted(
+                    self.replica_healthy.items()
+                )),
+            }
+
+
+class Router:
+    """The multi-replica front end: role-aware placement, KV migration,
+    session stickiness, health polling, drain-and-redistribute.  Use as
+    a context manager; ``Router.build`` constructs the replica fleet
+    in-process."""
+
+    def __init__(self, replicas: Dict[str, Server], *,
+                 replica_urls: Optional[Dict[str, str]] = None,
+                 max_redistributes: int = 8,
+                 health_interval: float = 0.25,
+                 admission_retry_s: float = 10.0,
+                 max_inflight: Optional[int] = None,
+                 slo: Optional[SloPolicy] = None,
+                 slo_timelines: int = 256,
+                 own_servers: bool = False):
+        if not replicas:
+            raise ValueError("router needs at least one replica")
+        urls = replica_urls or {}
+        self._replicas: Dict[str, Replica] = {
+            name: Replica(name, srv, urls.get(name))
+            for name, srv in sorted(replicas.items())
+        }
+        roles = {r.role for r in self._replicas.values()}
+        self.mode = "colocated" if roles == {"both"} else "disagg"
+        engines = [r.server.engine for r in self._replicas.values()]
+        e0 = engines[0]
+        for e in engines[1:]:
+            if (e.max_len != e0.max_len
+                    or e.vocab_size != e0.vocab_size):
+                raise ValueError(
+                    "replicas must share model geometry: got max_len "
+                    f"{e.max_len} vs {e0.max_len}, vocab {e.vocab_size} "
+                    f"vs {e0.vocab_size}"
+                )
+        if self.mode == "disagg":
+            for name, rep in self._replicas.items():
+                e = rep.server.engine
+                if not e.paged:
+                    raise ValueError(
+                        f"disaggregated mode needs paged engines "
+                        f"(kv_page_size > 0): replica '{name}' is "
+                        "contiguous — pages are the migration unit"
+                    )
+                if e.kv_page_size != engines[0].kv_page_size:
+                    raise ValueError(
+                        "replicas must share kv_page_size for migration"
+                    )
+        self.max_len = e0.max_len
+        self.vocab_size = e0.vocab_size
+        self._spec_slack = max(e.spec_k for e in engines)
+        self._affinity_block = max(
+            e0.kv_page_size, 1
+        ) if e0.paged else 16
+        self.max_redistributes = int(max_redistributes)
+        self.admission_retry_s = float(admission_retry_s)
+        self.max_inflight = (
+            int(max_inflight) if max_inflight is not None
+            else sum(
+                r.server.scheduler.max_queue + r.server.engine.max_batch
+                for r in self._replicas.values()
+            )
+        )
+        self._own_servers = own_servers
+        self.metrics = RouterMetrics()
+        self.slo = SloTracker(policy=slo, keep_timelines=slo_timelines)
+        self._log = get_logger("ml_trainer_tpu.serving.router")
+        self._lock = threading.Lock()
+        self._sessions: Dict[str, str] = {}
+        self._inflight = 0
+        self._stopping = False
+        self._stop_event = threading.Event()
+        self._httpd = None
+        self._http_thread = None
+        prefill_names = [
+            n for n, r in self._replicas.items()
+            if r.role in ("prefill", "both")
+        ] or list(self._replicas)
+        self._ring = _HashRing(prefill_names)
+        for rep in self._replicas.values():
+            rep.last_health = rep.fetch_health()
+            self.metrics.set_replica_health(rep.name, True)
+        self._health_interval = float(health_interval)
+        self._poller = threading.Thread(
+            target=self._poll_health, daemon=True, name="router-health"
+        )
+        self._poller.start()
+
+    # -- construction -----------------------------------------------------
+
+    @classmethod
+    def build(cls, model, variables: dict, roles: Sequence[str],
+              max_batch: int = 4, kv_page_size: int = 16,
+              router_kwargs: Optional[dict] = None,
+              **server_kwargs) -> "Router":
+        """Build an in-process replica fleet: one ``Server`` per entry
+        of ``roles`` (named ``prefill0``/``decode0``/``rep0``...), all
+        sharing ``model``/``variables`` (and therefore the process
+        compile cache), plus the router in front.  The router OWNS the
+        servers — ``close()`` closes them."""
+        counts: Dict[str, int] = {}
+        replicas: Dict[str, Server] = {}
+        for role in roles:
+            stem = {"prefill": "prefill", "decode": "decode"}.get(
+                role, "rep"
+            )
+            i = counts.get(stem, 0)
+            counts[stem] = i + 1
+            replicas[f"{stem}{i}"] = Server(
+                model, variables, max_batch=max_batch,
+                kv_page_size=kv_page_size, role=role, **server_kwargs
+            )
+        return cls(replicas, own_servers=True, **(router_kwargs or {}))
+
+    def replica(self, name: str) -> Replica:
+        return self._replicas[name]
+
+    @property
+    def replicas(self) -> Dict[str, Replica]:
+        return dict(self._replicas)
+
+    # -- client surface ---------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0, rng=None,
+               eos_token_id: Optional[int] = None,
+               deadline: Optional[float] = None,
+               tenant: str = "default", priority: int = 0,
+               session: Optional[str] = None) -> TokenStream:
+        """Route one request (thread-safe).  The returned stream is the
+        same surface ``Server.submit`` gives — tokens arrive as the
+        serving replicas produce them, across migration and
+        redistribution transparently.  ``session`` pins the request's
+        decode to a sticky replica for multi-turn streams."""
+        if self._stopping:
+            raise RuntimeError("router is closed")
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D token array")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        if prompt.size + max_new_tokens + self._spec_slack > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + new tokens ({max_new_tokens}) "
+                f"exceeds the fleet's max_len ({self.max_len})"
+            )
+        if eos_token_id is not None and not (
+            0 <= eos_token_id < self.vocab_size
+        ):
+            raise ValueError(
+                f"eos_token_id must be in [0, {self.vocab_size}), got "
+                f"{eos_token_id}"
+            )
+        if not isinstance(tenant, str) or not tenant:
+            raise ValueError(
+                f"tenant must be a non-empty string, got {tenant!r}"
+            )
+        with self._lock:
+            if self._inflight >= self.max_inflight:
+                raise AdmissionError(
+                    f"router at its in-flight watermark "
+                    f"({self.max_inflight}); request rejected"
+                )
+            self._inflight += 1
+        creq = Request(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            temperature=float(temperature), rng=rng,
+            eos_token_id=eos_token_id, deadline=deadline,
+            tenant=tenant, priority=int(priority),
+        )
+        creq.observer = self.slo.observe
+        self.slo.track(creq)
+        threading.Thread(
+            target=self._run_request, args=(creq, session), daemon=True,
+            name=f"router-req-{creq.id}",
+        ).start()
+        return TokenStream(creq, prompt)
+
+    def complete(self, prompt, max_new_tokens: int,
+                 timeout: Optional[float] = None, **kwargs) -> np.ndarray:
+        """Blocking one-shot through the router."""
+        return self.submit(prompt, max_new_tokens, **kwargs).result(
+            timeout=timeout
+        )
+
+    def kill_replica(self, name: str) -> None:
+        """Simulate a replica death (tests/chaos): the replica fails its
+        in-flight work with structured errors — which the router
+        redistributes — and leaves the placement pool."""
+        rep = self._replicas[name]
+        rep.healthy = False
+        self.metrics.set_replica_health(name, False)
+        rep.server._mark_unhealthy(f"replica '{name}' killed")
+
+    def health(self) -> dict:
+        """The router ``/healthz`` payload: aggregate liveness plus
+        every replica's last health snapshot."""
+        reps = {
+            name: {
+                "healthy": rep.healthy,
+                "role": rep.role,
+                **{
+                    k: rep.last_health.get(k)
+                    for k in ("active_slots", "queue_depth",
+                              "kv_pages_free", "adoptions_pending")
+                },
+            }
+            for name, rep in self._replicas.items()
+        }
+        n_alive = sum(1 for r in self._replicas.values() if r.healthy)
+        with self._lock:
+            inflight = self._inflight
+        return {
+            "ok": n_alive > 0 and not self._stopping,
+            "mode": self.mode,
+            "replicas_alive": n_alive,
+            "replicas_total": len(self._replicas),
+            "inflight": inflight,
+            "sessions": len(self._sessions),
+            "replicas": reps,
+        }
+
+    def snapshot(self) -> dict:
+        """Router metrics + health in one JSON-safe dict (the bench
+        artifact's router section)."""
+        snap = self.metrics.snapshot()
+        snap["mode"] = self.mode
+        with self._lock:
+            snap["inflight"] = self._inflight
+            snap["sessions"] = len(self._sessions)
+        return snap
+
+    def close(self) -> None:
+        self._stopping = True
+        self._stop_event.set()
+        if self._own_servers:
+            for rep in self._replicas.values():
+                rep.server.close()
+        self._poller.join(timeout=10.0)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- placement --------------------------------------------------------
+
+    def _alive(self) -> Dict[str, Replica]:
+        return {
+            n: r for n, r in self._replicas.items() if r.placeable()
+        }
+
+    def _affinity_key(self, tenant: str, prompt: np.ndarray) -> bytes:
+        block = np.asarray(
+            prompt[: self._affinity_block], np.int32
+        ).tobytes()
+        return tenant.encode() + b"|" + block
+
+    def _place(self, creq: Request,
+               session: Optional[str]) -> Tuple[Replica, Replica]:
+        """(prefill replica, decode replica) for this attempt, from live
+        health.  Raises ``EngineUnhealthy`` when nothing is placeable."""
+        alive = self._alive()
+        if not alive:
+            raise EngineUnhealthy("no healthy replica available")
+        key = self._affinity_key(creq.tenant, creq.prompt)
+        if self.mode == "colocated":
+            name = self._ring.place(key, alive) or sorted(alive)[0]
+            rep = alive[name]
+            return rep, rep
+        prefill_pool = {
+            n: r for n, r in alive.items()
+            if r.role in ("prefill", "both")
+        } or alive  # degraded: every engine CAN prefill
+        decode_pool = {
+            n: r for n, r in alive.items()
+            if r.role in ("decode", "both")
+        } or alive
+        name = self._ring.place(key, prefill_pool) or sorted(prefill_pool)[0]
+        prefill = prefill_pool[name]
+        decode = None
+        if session:
+            with self._lock:
+                sticky = self._sessions.get(session)
+            if sticky in decode_pool:
+                decode = decode_pool[sticky]
+        if decode is None:
+            decode = min(decode_pool.values(), key=Replica.load_score)
+            if session:
+                with self._lock:
+                    self._sessions[session] = decode.name
+        decode.pending += 1
+        return prefill, decode
+
+    def _decode_candidates(self) -> List[Replica]:
+        alive = self._alive()
+        pool = [
+            r for r in alive.values() if r.role in ("decode", "both")
+        ] or list(alive.values())
+        return sorted(pool, key=Replica.load_score)
+
+    # -- the per-request state machine ------------------------------------
+
+    def _run_request(self, creq: Request, session: Optional[str]) -> None:
+        try:
+            self._serve(creq, session)
+        except Exception as e:  # noqa: BLE001 — never hang a client
+            if creq.state in ("queued", "active"):
+                self.metrics.record_error()
+                creq.finish(
+                    "error", f"router failure: {type(e).__name__}: {e}"
+                )
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _remaining_deadline(self, creq: Request) -> Optional[float]:
+        if creq.deadline is None:
+            return None
+        return creq.deadline - (time.monotonic() - creq.submitted_at)
+
+    def _shadow(self, creq: Request, committed: List[int],
+                deadline: Optional[float]) -> Request:
+        """The per-attempt replica-local request: same prompt and
+        sampling state, committed tokens preloaded (resume prefix), the
+        remaining deadline budget, and the cumulative preemption count
+        so engine give-ups stay structured across replicas."""
+        shadow = Request(
+            prompt=creq.prompt, max_new_tokens=creq.max_new_tokens,
+            temperature=creq.temperature, rng=creq.rng,
+            eos_token_id=creq.eos_token_id, deadline=deadline,
+            tenant=creq.tenant, priority=creq.priority,
+        )
+        shadow.tokens = [int(t) for t in committed]
+        shadow.preemptions = creq.preemptions
+        return shadow
+
+    def _serve(self, creq: Request, session: Optional[str]) -> None:
+        redistributes = 0
+        while True:
+            if self._stopping:
+                creq.finish("error", "router is closed")
+                return
+            deadline = self._remaining_deadline(creq)
+            if deadline is not None and deadline <= 0:
+                creq.finish(
+                    "expired",
+                    f"deadline ({creq.deadline}s) passed while routing",
+                )
+                return
+            # Resume from what the CLIENT received, not what the shadow
+            # recorded: a dying replica's last decode step can append a
+            # token to the shadow after its stream was failed, and a
+            # token the pump never forwarded must be recomputed (it is —
+            # deterministically), never skipped.
+            shadow = self._shadow(creq, list(creq.tokens), deadline)
+            placed = self._submit_attempt(creq, shadow, session)
+            if placed is None:
+                return  # _submit_attempt finished creq with the reason
+            decode_rep = placed
+            outcome = self._pump(creq, shadow, decode_rep)
+            if outcome == "done":
+                creq.preemptions = shadow.preemptions
+                creq.finish("done")
+                return
+            if outcome == "expired":
+                creq.finish("expired", shadow.error)
+                return
+            if outcome == "retry":
+                redistributes += 1
+                self.metrics.record_redistribute()
+                creq.preemptions = shadow.preemptions + 1
+                creq.mark(
+                    "redistributed", attempt=redistributes,
+                    committed_tokens=len(creq.tokens), error=shadow.error,
+                )
+                if redistributes > self.max_redistributes:
+                    self.metrics.record_error()
+                    creq.finish(
+                        "error",
+                        f"request {creq.id} (tenant '{creq.tenant}') "
+                        f"redistributed {redistributes}x after replica "
+                        f"failures; giving up after max_redistributes="
+                        f"{self.max_redistributes} (last: {shadow.error})",
+                    )
+                    return
+                continue
+            self.metrics.record_error()
+            creq.finish("error", shadow.error or "replica error")
+            return
+
+    def _submit_attempt(self, creq: Request, shadow: Request,
+                        session: Optional[str]) -> Optional[Replica]:
+        """Place + submit one attempt.  Returns the decode replica on
+        success, or None after finishing ``creq`` with a structured
+        error (placement/admission exhausted)."""
+        give_up_at = time.monotonic() + self.admission_retry_s
+        last_err = "no healthy replica available"
+        while not self._stopping:
+            try:
+                prefill_rep, decode_rep = self._place(creq, session)
+            except EngineUnhealthy as e:
+                last_err = str(e)
+                if time.monotonic() > give_up_at:
+                    break
+                self._stop_event.wait(0.05)
+                continue
+            disagg = prefill_rep is not decode_rep
+            shadow.migration_sink = (
+                (lambda r, exp: r._stream.put((_MIGRATE, exp)))
+                if disagg else None
+            )
+            try:
+                prefill_rep.server.submit_request(shadow)
+            except AdmissionError as e:
+                last_err = str(e)
+                if time.monotonic() > give_up_at:
+                    break
+                self._stop_event.wait(0.02)
+                continue
+            except (EngineUnhealthy, RuntimeError) as e:
+                # The poller will confirm, but don't wait for it.
+                last_err = str(e)
+                prefill_rep.healthy = False
+                self.metrics.set_replica_health(prefill_rep.name, False)
+                if time.monotonic() > give_up_at:
+                    break
+                continue
+            creq.mark(
+                "routed", prefill=prefill_rep.name,
+                decode=decode_rep.name, disagg=disagg,
+            )
+            self.metrics.record_request(
+                prefill_rep.name, "prefill" if disagg else "colocated"
+            )
+            return decode_rep
+        self.metrics.record_error()
+        creq.finish(
+            "error",
+            f"router could not place request {creq.id} (tenant "
+            f"'{creq.tenant}'): {last_err}",
+        )
+        return None
+
+    def _pump(self, creq: Request, shadow: Request,
+              decode_rep: Replica) -> str:
+        """Forward the shadow's stream to the client, adopting the KV
+        export into the decode replica when it arrives.  Returns
+        ``done`` / ``expired`` / ``retry`` (replica failure —
+        redistribute) / ``error`` (structured terminal)."""
+        while True:
+            try:
+                item = shadow._stream.get(timeout=0.5)
+            except _queue.Empty:
+                if self._stopping:
+                    shadow.error = shadow.error or "router is closed"
+                    return "error"
+                continue
+            if item == _DONE:
+                if shadow.state == "done":
+                    return "done"
+                if shadow.state == "expired":
+                    return "expired"
+                if self._stopping or not self._retryable(shadow.error):
+                    return "error"
+                return "retry"
+            if isinstance(item, tuple) and item[0] == _MIGRATE:
+                if not self._adopt(creq, shadow, decode_rep, item[1]):
+                    return "retry"
+                continue
+            creq.push_token(int(item))
+
+    def _adopt(self, creq: Request, shadow: Request,
+               decode_rep: Replica, export) -> bool:
+        """Hand the exported KV to a decode replica — the placed one
+        first, any healthy decode candidate as fallback.  The payload
+        round-trips through the serialized form so the migration is
+        transport-shaped and metered in real bytes."""
+        payload = transfer.to_bytes(export)
+        export = transfer.from_bytes(payload)
+        candidates = [decode_rep] + [
+            r for r in self._decode_candidates() if r is not decode_rep
+        ]
+        for rep in candidates:
+            if not rep.placeable():
+                continue
+            try:
+                rep.server.adopt(shadow, export)
+            except AdmissionError:
+                continue
+            except (EngineUnhealthy, RuntimeError):
+                rep.healthy = False
+                self.metrics.set_replica_health(rep.name, False)
+                continue
+            self.metrics.record_migration(len(payload))
+            self.metrics.record_request(rep.name, "decode")
+            creq.mark(
+                "kv_migrated", to=rep.name, kv_bytes=len(payload),
+                pages=export.n_pages,
+            )
+            return True
+        shadow.error = (
+            "serving engine unhealthy: no decode replica could adopt "
+            "the migrated KV"
+        )
+        return False
+
+    @staticmethod
+    def _retryable(err: Optional[str]) -> bool:
+        """Replica-level failures redistribute; the engine's structured
+        give-ups (max_preemptions) and unknown errors surface to the
+        client as-is."""
+        if not err:
+            return False
+        if "max_preemptions" in err:
+            return False
+        return any(
+            needle in err
+            for needle in ("unhealthy", "server closed", "wedged",
+                           "engine thread died", "killed")
+        )
+
+    # -- health polling ---------------------------------------------------
+
+    def _poll_health(self) -> None:
+        while not self._stopping:
+            for rep in self._replicas.values():
+                payload = rep.fetch_health()
+                rep.last_health = payload
+                rep.pending = 0
+                ok = (
+                    bool(payload.get("healthy"))
+                    and not payload.get("draining")
+                    and not payload.get("closed")
+                )
+                if rep.healthy and not ok:
+                    self._log.error(
+                        "router_replica_unhealthy", replica=rep.name,
+                        reason=payload.get("reason"),
+                    )
+                rep.healthy = ok
+                self.metrics.set_replica_health(rep.name, ok)
+            self._stop_event.wait(self._health_interval)
+
+    # -- telemetry --------------------------------------------------------
+
+    def publish(self, registry=None) -> dict:
+        """Mirror the router counters into the telemetry registry (and
+        return the snapshot): ``router_requests_total{role=,replica=}``,
+        ``router_kv_migrated_bytes_total``,
+        ``router_replica_healthy{replica=}``, redistribution/migration
+        totals, the router-level SLO attainment, and each replica's
+        attainment re-labeled by replica through its existing
+        SloTracker."""
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        r = registry if registry is not None else default_registry()
+        snap = self.metrics.snapshot()
+        req = r.gauge(
+            "router_requests_total",
+            "requests placed by the router, by role and replica",
+            labelnames=("role", "replica"),
+        )
+        for key, n in snap["requests_total"].items():
+            role, replica = key.split("/", 1)
+            req.labels(role=role, replica=replica).set(float(n))
+        r.gauge(
+            "router_kv_migrated_bytes_total",
+            "serialized KV payload bytes migrated prefill -> decode",
+        ).set(float(snap["kv_migrated_bytes_total"]))
+        r.gauge(
+            "router_migrations_total",
+            "KV migrations adopted by decode replicas",
+        ).set(float(snap["migrations_total"]))
+        r.gauge(
+            "router_redistributes_total",
+            "in-flight requests redistributed off a failed replica",
+        ).set(float(snap["redistributes_total"]))
+        healthy = r.gauge(
+            "router_replica_healthy",
+            "1 while the replica is placeable, 0 once it left the pool",
+            labelnames=("replica",),
+        )
+        for name, ok in snap["replica_healthy"].items():
+            healthy.labels(replica=name).set(float(ok))
+        att = r.gauge(
+            "router_replica_slo_attainment",
+            "per-replica SLO attainment (each replica's own SloTracker)",
+            labelnames=("slo", "replica"),
+        )
+        for name, rep in self._replicas.items():
+            rep_snap = rep.server.slo.snapshot()
+            for k in ("ttft", "tpot"):
+                att.labels(slo=k, replica=name).set(
+                    rep_snap["attainment"][k]
+                )
+        self.slo.publish(r)
+        return snap
+
+    # -- HTTP front end ---------------------------------------------------
+
+    def serve_http(self, host: str = "127.0.0.1", port: int = 0):
+        """The router's stdlib HTTP front end (same contract as
+        ``Server.serve_http``): POST ``/v1/generate`` (plus an optional
+        ``"session"`` key for stickiness), GET ``/healthz`` /
+        ``/metrics`` / ``/metrics.json`` / ``/slo``."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ml_trainer_tpu.serving.scheduler import DeadlineExceeded
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet: we have metrics
+                pass
+
+            def _send(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    payload = router.health()
+                    self._send(200 if payload["ok"] else 503, payload)
+                elif self.path == "/metrics":
+                    from ml_trainer_tpu.telemetry.registry import (
+                        default_registry,
+                    )
+
+                    registry = default_registry()
+                    router.publish(registry)
+                    body = registry.prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/metrics.json":
+                    self._send(200, router.snapshot())
+                elif self.path == "/slo":
+                    self._send(200, router.slo.snapshot())
+                else:
+                    self._send(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._send(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    session = body.get("session")
+                    out = router.complete(
+                        np.asarray(body["prompt"], np.int32),
+                        int(body.get("max_new_tokens", 16)),
+                        temperature=float(body.get("temperature", 0.0)),
+                        rng=body.get("seed"),
+                        eos_token_id=body.get("eos_token_id"),
+                        deadline=body.get("deadline"),
+                        tenant=str(body.get("tenant", "default")),
+                        priority=int(body.get("priority", 0)),
+                        session=str(session) if session else None,
+                    )
+                    self._send(200, {"tokens": [int(t) for t in out]})
+                except AdmissionError as e:
+                    self._send(429, {"error": str(e)})
+                except EngineUnhealthy as e:
+                    self._send(503, {"error": str(e)})
+                except (DeadlineExceeded, TimeoutError) as e:
+                    self._send(504, {"error": str(e)})
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="router-http",
+        )
+        self._http_thread.start()
+        return self._httpd.server_address

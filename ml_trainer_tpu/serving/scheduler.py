@@ -144,6 +144,12 @@ class Request:
     # can't inflate hit rates or re-heat its own prefix pages while the
     # engine is trying to evict its way out of the pressure.
     kv_blocked: bool = False
+    # Disaggregated serving (serving/router.py): when set, the serving
+    # loop exports the slot's KV right after prefill and hands
+    # ``(request, export)`` to this callable instead of decoding in
+    # place — the router adopts the request into a decode replica.
+    # Cleared at export so a later preempt-resume decodes where it is.
+    migration_sink: object = None
     admitted_at: Optional[float] = None
     error: Optional[str] = None
     first_token_at: Optional[float] = None
@@ -440,6 +446,30 @@ class TenantScheduler:
                 req.mark("admitted", slot=slot)
                 return req, slot
             return None
+
+    def acquire_direct(self, req: Request) -> Optional[int]:
+        """Claim a free slot for an externally placed request — a
+        KV-adopted migration landing from another replica's prefill —
+        bypassing the queues.  The request was already admitted (and
+        charged) at the router, so tenant ``max_active`` quotas are not
+        re-applied here (re-applying them could wedge an adoption whose
+        prefill budget is already spent); the tenant's active count IS
+        charged so ``release`` bookkeeping stays balanced.  Returns the
+        slot, or None when none is free right now."""
+        with self._lock:
+            if not self._free_slots:
+                return None
+            self._cfg(req.tenant)
+            slot = self._free_slots.pop()
+            self._slot_tenant[slot] = req.tenant
+            self._active[req.tenant] = self._active.get(req.tenant, 0) + 1
+            req.slot = slot
+            req.state = "active"
+            req.admitted_at = time.monotonic()
+            if req.first_admitted_at is None:
+                req.first_admitted_at = req.admitted_at
+            req.mark("adopt_admitted", slot=slot)
+            return slot
 
     def active_counts(self) -> Dict[str, int]:
         with self._lock:

@@ -178,6 +178,15 @@ class SloTracker:
             if not req._observed:
                 self._active[req.id] = req
 
+    def forget(self, req) -> None:
+        """Drop an in-flight registration WITHOUT consuming it — the
+        request is migrating to another replica whose tracker takes
+        over (``Server.adopt`` re-tracks it there), so this replica's
+        accounting must neither leak the active entry nor claim the
+        finished timeline."""
+        with self._lock:
+            self._active.pop(req.id, None)
+
     def observe(self, req) -> None:
         """Consume one FINISHED request's timeline into the accounting.
         Called from ``Request.finish`` (any thread, exactly once)."""

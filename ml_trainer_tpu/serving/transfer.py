@@ -1,0 +1,317 @@
+"""Block-granular KV migration between engine replicas.
+
+Disaggregated serving (serving/router.py, docs/serving.md) runs a
+request's prefill on one replica and its decode on another, which means
+the request's KV cache must MOVE between two :class:`KVPagePool`-backed
+engines mid-lifecycle.  This module is that transfer unit:
+
+* **Export** (:func:`export_kv_slot`): gather one slot's page chain out
+  of every layer's page pool — in LOGICAL order, padded to
+  ``pages_per_slot`` rows so the gather/scatter programs compile ONCE
+  per engine geometry (pad rows index the trash page on both sides, the
+  same harmless-garbage idiom the device decode path already relies
+  on) — plus the host continuation state the target engine needs to
+  keep sampling byte-identically: consumed position, committed tokens,
+  the pending input token, temperature, the normalized rng key and the
+  per-token fold counter.
+* **Import** (:func:`import_kv_slot`): allocate the same number of
+  pages in the TARGET pool, bind the slot, scatter the exported rows in
+  bit-for-bit, set the slot's index/token rows and host mirrors, and
+  register the request as active — the target's next decode step
+  continues exactly where the source's would have.  The migrated
+  prompt's full blocks are donated to the target's prefix cache through
+  the same radix-insert machinery that moves written blocks between
+  owners on preemption, so affinity-routed followers hit on the decode
+  side too.
+* **Serialization** (:func:`to_bytes` / :func:`from_bytes`): the export
+  as one self-describing byte payload (``np.savez`` + JSON meta), so
+  the transfer is transport-ready (HTTP/IPC) and the router can meter
+  ``router_kv_migrated_bytes_total`` honestly.
+
+Byte-identity argument: the paged engine's prefill scatter-inserts the
+CONTIGUOUS batch-1 prefill cache into pages bit-for-bit (the PR6
+anchor), and this module copies those same page contents bit-for-bit
+into the target pool while reproducing the per-slot sampling state
+(rng, fold counter, temperature, pending token).  The target engine
+therefore computes exactly the forward the source would have — pinned
+by tests/test_router.py for greedy AND spec_k continuations.
+
+Draft-model speculative caches are NOT migrated: verification makes
+draft quality a performance knob, never a correctness one, so an
+adopted slot simply re-drafts from a cold draft cache (the n-gram
+drafter is host-side and needs nothing).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+from typing import List
+
+import jax
+import numpy as np
+
+from ml_trainer_tpu.generate import _COMPILED
+
+
+def _leaf_name(path):
+    """Last dict key of a tree path (None for non-dict paths)."""
+    return getattr(path[-1], "key", None) if path else None
+
+
+@dataclasses.dataclass
+class KVSlotExport:
+    """One slot's migratable state: pool geometry, continuation state,
+    and the page payload (one ``[pages_per_slot, H, page, D]`` array per
+    K/V cache leaf, logical order, trash-padded past ``n_pages``)."""
+
+    # -- geometry (validated against the target engine on import) -------
+    page_size: int
+    pages_per_slot: int
+    max_len: int
+    n_pages: int            # live pages in the chain (<= pages_per_slot)
+    pos: int                # consumed positions (device cache_index mirror)
+    # -- continuation state ---------------------------------------------
+    prompt: np.ndarray      # the request's ORIGINAL prompt ids (int32)
+    tokens: List[int]       # committed generated tokens so far
+    last_token: int         # the pending decode input (the engine tok row)
+    temperature: float
+    rng_key: np.ndarray     # normalized uint32[2] PRNG key data
+    step_counter: int       # per-token fold counter (_steps mirror)
+    # -- payload ---------------------------------------------------------
+    layers: List[np.ndarray]
+
+    def nbytes(self) -> int:
+        """Device-payload bytes this migration moves (the metered
+        quantity; host metadata is noise next to the K/V pages)."""
+        return int(sum(a.nbytes for a in self.layers))
+
+
+def _pool_leaf_paths(cache) -> list:
+    """(path, leaf) for every K/V pool leaf (ndim 4), in the stable tree
+    flatten order export and import both iterate."""
+    from jax import tree_util
+
+    return [
+        (path, leaf)
+        for path, leaf in tree_util.tree_flatten_with_path(cache)[0]
+        if getattr(leaf, "ndim", 0) == 4
+    ]
+
+
+def _check_paged(engine) -> None:
+    if not getattr(engine, "paged", False):
+        raise ValueError(
+            "KV migration needs a paged engine (kv_page_size > 0): the "
+            "page chain is the transfer unit"
+        )
+
+
+def export_kv_slot(engine, slot: int) -> KVSlotExport:
+    """Export ``slot``'s pages + continuation state from ``engine``.
+
+    The slot must hold an active request (the engine's ``_active`` map
+    is the source of the continuation metadata).  The engine keeps
+    running afterwards — export only READS; the caller decides whether
+    to release the slot (migration) or keep it (checkpoint/fork).
+    """
+    _check_paged(engine)
+    req = engine._active.get(slot)
+    if req is None:
+        raise ValueError(f"slot {slot} holds no active request")
+    pool = engine.pool
+    row = engine._page_row(slot)            # [pages_per_slot], trash-padded
+    leaves = [leaf for _, leaf in _pool_leaf_paths(engine.cache)]
+
+    key = ("kv_export", engine._key_model, engine.max_batch)
+    run = _COMPILED.get(key)
+    if run is None:
+        # One gather per pool leaf at a dynamic index vector: the padded
+        # row keeps the shape static, so this compiles once per engine
+        # geometry and the zero-recompile pin covers migration traffic.
+        run = jax.jit(lambda ls, idx: [l[idx] for l in ls])
+        _COMPILED[key] = run
+    gathered = run(leaves, np.asarray(row, np.int32))
+    # Migration fence: the payload must be host bytes before the source
+    # slot is released.  # graft-lint: sync-ok
+    layers = [np.asarray(g) for g in gathered]
+    return KVSlotExport(
+        page_size=pool.page_size,
+        pages_per_slot=pool.pages_per_slot,
+        max_len=engine.max_len,
+        n_pages=pool.slot_page_count(slot),
+        pos=int(engine._pos[slot]),
+        prompt=np.asarray(req.prompt, np.int32).reshape(-1),
+        tokens=[int(t) for t in req.tokens],
+        last_token=int(np.asarray(engine.tok)[slot, 0]),
+        temperature=float(engine._temps[slot]),
+        rng_key=np.asarray(engine._rngs[slot], np.uint32).copy(),
+        step_counter=int(engine._steps[slot]),
+        layers=layers,
+    )
+
+
+def import_kv_slot(engine, req, slot: int, exp: KVSlotExport) -> str:
+    """Scatter ``exp`` into ``engine``'s pool at ``slot`` and register
+    ``req`` (the continuation request — same prompt, its ``tokens``
+    already carrying the committed stream) as active.
+
+    Returns ``"active"``, or ``"no_memory"`` when the target pool
+    cannot hold the chain even after evicting cold prefix pages — the
+    caller falls back to requeue-and-reprefill (the preempt-resume
+    path), which stays byte-identical, just slower.
+    """
+    _check_paged(engine)
+    if slot in engine._active:
+        raise ValueError(f"slot {slot} is already occupied")
+    pool = engine.pool
+    if (pool.page_size != exp.page_size
+            or pool.pages_per_slot != exp.pages_per_slot
+            or engine.max_len != exp.max_len):
+        raise ValueError(
+            f"pool geometry mismatch: export is page_size="
+            f"{exp.page_size} x {exp.pages_per_slot} (max_len "
+            f"{exp.max_len}), target is {pool.page_size} x "
+            f"{pool.pages_per_slot} (max_len {engine.max_len})"
+        )
+    paths = _pool_leaf_paths(engine.cache)
+    if len(paths) != len(exp.layers):
+        raise ValueError(
+            f"layer count mismatch: export has {len(exp.layers)} pool "
+            f"leaves, target model has {len(paths)}"
+        )
+    for (_, leaf), arr in zip(paths, exp.layers):
+        if tuple(leaf.shape[1:]) != tuple(arr.shape[1:]):
+            raise ValueError(
+                f"page geometry mismatch: export page rows "
+                f"{arr.shape[1:]}, target pool {tuple(leaf.shape[1:])}"
+            )
+
+    pages = pool.allocate(exp.n_pages)
+    if pages is None and engine._prefix is not None:
+        engine._prefix.evict(exp.n_pages - pool.free_count())
+        pages = pool.allocate(exp.n_pages)
+    if pages is None:
+        return "no_memory"
+    pool.bind_slot(slot, pages)
+    row = engine._page_row(slot)            # [pages_per_slot], trash-padded
+
+    key = ("kv_import", engine._key_model, engine.max_batch)
+    run = _COMPILED.get(key)
+    if run is None:
+        run = jax.jit(_build_import(), donate_argnums=(0, 1))
+        _COMPILED[key] = run
+    engine.cache, engine.tok = run(
+        engine.cache, engine.tok, exp.layers,
+        np.asarray(row, np.int32), np.int32(slot),
+        np.int32(exp.pos), np.int32(exp.last_token),
+    )
+    # Host mirrors of the slot's sampling/position state — what keeps
+    # the continuation byte-identical to the never-migrated run.
+    engine._pos[slot] = exp.pos
+    engine._temps[slot] = exp.temperature
+    engine._rngs[slot] = exp.rng_key
+    engine._steps[slot] = exp.step_counter
+    if engine.spec_k:
+        # The verify-window write cap, recomputed exactly as admit()
+        # prices it (independent of how far the stream has advanced).
+        engine._caps[slot] = min(
+            int(exp.prompt.size) + int(req.max_new_tokens) - 1,
+            engine.max_len - engine.spec_k - 1,
+        )
+    req.slot = slot
+    req.state = "active"
+    engine._active[slot] = req
+    if engine._prefix is not None:
+        # Donate the migrated FULL blocks (prompt + committed tokens
+        # whose K/V is already written — everything before ``pos``) to
+        # the target's prefix cache: the same radix-insert machinery
+        # preemption uses to move written blocks between owners.
+        seq = np.concatenate(
+            [exp.prompt, np.asarray(exp.tokens, np.int32)]
+        )[: exp.pos]
+        blocks = exp.pos // pool.page_size
+        if blocks:
+            engine._prefix.insert(
+                seq, pool.slot_pages[slot][:blocks],
+                namespace=engine._prefix_ns(req),
+            )
+    engine._push_kv_metrics()
+    return "active"
+
+
+def _build_import():
+    """The compiled import: scatter the padded page rows into every pool
+    leaf, set the slot's index vector and pending-token row.  Page-table
+    leaves pass through untouched — the host table (pool.bind_slot set
+    it) uploads via the engine's ordinary dirty-sync before the next
+    step, the same path every allocation takes."""
+    import jax.numpy as jnp
+    from jax import tree_util
+
+    def run(cache, tok, layers, row, slot, pos, last_token):
+        flat, treedef = tree_util.tree_flatten_with_path(cache)
+        out, li = [], 0
+        for path, leaf in flat:
+            if leaf.ndim == 4:
+                out.append(leaf.at[row].set(layers[li].astype(leaf.dtype)))
+                li += 1
+            elif _leaf_name(path) == "page_table":
+                out.append(leaf)
+            else:
+                out.append(leaf.at[slot].set(jnp.asarray(pos, leaf.dtype)))
+        cache = tree_util.tree_unflatten(treedef, out)
+        tok = tok.at[slot, 0].set(last_token)
+        return cache, tok
+
+    return run
+
+
+# ------------------------------------------------------- serialization
+
+def to_bytes(exp: KVSlotExport) -> bytes:
+    """One self-describing byte payload (transport-ready; what the
+    router meters as migrated bytes)."""
+    meta = {
+        "page_size": exp.page_size,
+        "pages_per_slot": exp.pages_per_slot,
+        "max_len": exp.max_len,
+        "n_pages": exp.n_pages,
+        "pos": exp.pos,
+        "tokens": list(exp.tokens),
+        "last_token": exp.last_token,
+        "temperature": exp.temperature,
+        "step_counter": exp.step_counter,
+        "n_layers": len(exp.layers),
+    }
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        prompt=exp.prompt,
+        rng_key=exp.rng_key,
+        **{f"layer_{i}": a for i, a in enumerate(exp.layers)},
+    )
+    return buf.getvalue()
+
+
+def from_bytes(payload: bytes) -> KVSlotExport:
+    with np.load(io.BytesIO(payload), allow_pickle=False) as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        return KVSlotExport(
+            page_size=int(meta["page_size"]),
+            pages_per_slot=int(meta["pages_per_slot"]),
+            max_len=int(meta["max_len"]),
+            n_pages=int(meta["n_pages"]),
+            pos=int(meta["pos"]),
+            prompt=np.asarray(z["prompt"], np.int32),
+            tokens=[int(t) for t in meta["tokens"]],
+            last_token=int(meta["last_token"]),
+            temperature=float(meta["temperature"]),
+            rng_key=np.asarray(z["rng_key"], np.uint32),
+            step_counter=int(meta["step_counter"]),
+            layers=[
+                z[f"layer_{i}"] for i in range(int(meta["n_layers"]))
+            ],
+        )

@@ -570,6 +570,96 @@ def gate_slo(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_disagg_reference(repo: str = REPO):
+    """Disaggregated tokens/s from the committed router artifact
+    (docs/serving_disagg_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "serving_disagg_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = (data.get("disagg") or {}).get("tokens_per_sec")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_disagg(threshold: float, backend: str, fp: str) -> dict:
+    """The disaggregated-serving regression gate: a short run of the
+    recorded-trace replay through BOTH router topologies, gated —
+
+    1. **Invariants** (hard): every request's output byte-identical
+       between the disaggregated and colocated topologies, zero
+       compiles during either timed pass, zero client errors, and
+       migrations actually flowed (a disagg run with no migrations is
+       a colocated run wearing the wrong label).
+    2. **Trajectory/local baseline** on the disaggregated tokens/s,
+       with the same calibrate-then-ratchet fallback the parity gate
+       uses.  (The p99-TTFT WIN is pinned by the committed artifact —
+       a short gate run is too noisy to re-litigate it, so the gate
+       records the ratio without failing on it.)
+    """
+    import bench
+
+    result = bench.bench_serve_disagg(n_requests=32)
+    out = {
+        "disagg_tokens_per_sec": result["disagg"]["tokens_per_sec"],
+        "colocated_tokens_per_sec": result["colocated"]["tokens_per_sec"],
+        "ttft_p99_ratio": result["ttft_p99_ratio"],
+        "migrations": result["disagg"]["migrations"],
+        "kv_migrated_bytes": result["disagg"]["kv_migrated_bytes"],
+        "threshold": threshold,
+    }
+    if not result["byte_identical"]:
+        out.update(ok=False, decided_by="identity",
+                   error="disaggregated output diverged from colocated")
+        return out
+    if not result["zero_recompiles"]:
+        out.update(
+            ok=False, decided_by="zero_recompile",
+            error="compiles observed during a timed router pass: "
+            + str(result["disagg"].get("recompile_error")
+                  or result["colocated"].get("recompile_error")),
+        )
+        return out
+    n_err = result["disagg"]["n_errors"] + result["colocated"]["n_errors"]
+    if n_err:
+        out.update(ok=False, decided_by="client_errors",
+                   error=f"{n_err} client error(s) across topologies")
+        return out
+    if result["disagg"]["migrations"] < result["n_requests"]:
+        out.update(
+            ok=False, decided_by="migration_coverage",
+            error=f"only {result['disagg']['migrations']} migration(s) "
+            f"for {result['n_requests']} requests — the disagg leg is "
+            "not actually disaggregating",
+        )
+        return out
+    committed = committed_disagg_reference()
+    disagg_key = f"{backend}_serve_disagg"
+    baseline = load_baseline(disagg_key, fp)
+    decision = evaluate(
+        float(result["disagg"]["tokens_per_sec"]),
+        committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            disagg_key, fp,
+            max(float(result["disagg"]["tokens_per_sec"]),
+                baseline or 0.0),
+        )
+    elif "error" not in out:
+        out["error"] = (
+            f"disaggregated {result['disagg']['tokens_per_sec']} "
+            f"tokens/s is >{threshold * 100:.0f}% below this machine's "
+            f"baseline {baseline}"
+        )
+    return out
+
+
 def committed_goodput_reference(repo: str = REPO):
     """The committed memory/goodput artifact
     (docs/memory_goodput_cpu.json), or None."""
@@ -855,6 +945,8 @@ def main() -> int:
                         help="skip the pipeline-schedule gate")
     parser.add_argument("--skip-slo", action="store_true",
                         help="skip the serving-SLO open-loop gate")
+    parser.add_argument("--skip-disagg", action="store_true",
+                        help="skip the disaggregated-serving router gate")
     parser.add_argument("--skip-goodput", action="store_true",
                         help="skip the memory-ledger / goodput / "
                         "recompile gate")
@@ -955,6 +1047,20 @@ def main() -> int:
             f"{slo['tokens_per_sec']} tokens/s at {slo['offered_rps']} "
             f"rps, TTFT p99 {slo['ttft_p99_ms']} ms, attainment "
             f"{slo['attainment']}",
+            flush=True,
+        )
+    if not args.skip_disagg:
+        disagg = gate_disagg(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_disagg": disagg}), flush=True)
+        if not disagg["ok"]:
+            print(f"BENCH_GATE DISAGG FAIL: {disagg.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE DISAGG OK ({disagg['decided_by']}): "
+            f"disaggregated {disagg['disagg_tokens_per_sec']} tokens/s, "
+            f"TTFT p99 ratio {disagg['ttft_p99_ratio']} vs colocated, "
+            f"{disagg['migrations']} migration(s)",
             flush=True,
         )
     if not args.skip_goodput:

@@ -22,7 +22,12 @@
 # Poisson schedule through the real HTTP server — lifecycle latency
 # histograms + attainment/burn-rate exposition, nested request trace
 # spans, forced-preemption flight dump naming request ids with
-# timelines), an elastic-training smoke leg (scripts/elastic_smoke.py
+# timelines), a disaggregated-router smoke leg
+# (scripts/router_smoke.py: 2-replica in-process router — byte
+# identity through page-granular KV migration, router_* metrics on the
+# /metrics scrape, session stickiness, replica-kill
+# drain-and-redistribute with structured errors past the budget), an
+# elastic-training smoke leg (scripts/elastic_smoke.py
 # --quick: kill 1 of 2 simulated hosts mid-run; the same fit() drains,
 # reshapes 8 -> 4 devices and finishes with the uninterrupted
 # trajectory and a bit-exact-resumable history; the bench gate's
@@ -45,7 +50,10 @@
 # ratchet vs docs/pipeline_schedules_cpu.json), and the serving-SLO
 # gate (zero-recompile + zero-error invariants at the committed
 # artifact's highest offered rate, tokens/s ratchet vs
-# docs/serving_slo_cpu.json).
+# docs/serving_slo_cpu.json), and the disaggregated-router gate
+# (byte identity between topologies, zero recompiles, migration
+# coverage, disaggregated tokens/s ratchet vs
+# docs/serving_disagg_cpu.json; --skip-disagg to skip).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -86,6 +94,10 @@ echo "# serving-SLO smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 slo_rc=$?
 [ $slo_rc -ne 0 ] && echo "# slo smoke FAILED (rc=$slo_rc)"
+echo "# disaggregated-router smoke leg"
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/router_smoke.py
+router_rc=$?
+[ $router_rc -ne 0 ] && echo "# router smoke FAILED (rc=$router_rc)"
 echo "# elastic-training smoke leg (--quick: in-process reshape only;"
 echo "# the bench gate's gate_elastic runs the full cross-process leg)"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py --quick
@@ -117,6 +129,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$pipeline_rc
 [ $rc -eq 0 ] && rc=$memory_rc
 [ $rc -eq 0 ] && rc=$slo_rc
+[ $rc -eq 0 ] && rc=$router_rc
 [ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc

@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Disaggregated-router smoke leg (scripts/fastlane.sh) — ~90s on CPU.
+
+One short end-to-end pass over the router + KV-migration stack
+(serving/router.py, transfer.py), on a 2-replica in-process router:
+
+1. **Byte identity through migration.**  Requests routed
+   prefill -> page-granular KV migrate -> decode reproduce standalone
+   ``generate()`` outputs byte-for-byte (greedy and seeded sampling),
+   with real migrations counted and metered in bytes.
+2. **Routing surfaces.**  The router HTTP front end serves
+   ``/v1/generate`` (with sessions), and the ``/metrics`` scrape
+   carries the ``router_requests_total{role=,replica=}``,
+   ``router_kv_migrated_bytes_total``, ``router_replica_healthy`` and
+   per-replica SLO attainment series; replica ``/healthz`` exposes the
+   placement fields (role, queue_depth, kv_pages_free, active_slots).
+3. **Stickiness.**  A session pins its decode placement to one replica.
+4. **Replica-kill drain-and-redistribute.**  A decode replica dies
+   mid-stream: in-flight requests redistribute to a survivor with their
+   committed tokens as a resumable prefix and finish byte-identically;
+   with the redistribution budget at zero the client instead gets a
+   STRUCTURED error (never a hang).
+
+Exits non-zero (with a reason) on any violation.
+"""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str) -> int:
+    print(f"ROUTER_SMOKE FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    import jax
+
+    from ml_trainer_tpu.generate import generate
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import Router
+
+    model = get_model("gpt2_tiny", max_len=64)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        np.asarray(rng.integers(0, 1024, n), np.int32)
+        for n in (9, 6, 12, 8)
+    ]
+    refs = [
+        np.asarray(generate(model, variables, p[None], 12))[0]
+        for p in prompts
+    ]
+    ref_sampled = np.asarray(
+        generate(model, variables, prompts[0][None], 10, temperature=0.7,
+                 rng=jax.random.PRNGKey(7))
+    )[0]
+
+    # 1+2+3: 2-replica disaggregated router, driven over HTTP.
+    with Router.build(model, variables, roles=["prefill", "decode"],
+                      max_batch=2, kv_page_size=8) as router:
+        host, port = router.serve_http(port=0)
+        url = f"http://{host}:{port}"
+        outs = []
+        for i, p in enumerate(prompts):
+            body = json.dumps({
+                "prompt": [int(t) for t in p], "max_new_tokens": 12,
+                "tenant": f"t{i % 2}", "session": "chat-0",
+            }).encode()
+            req = urllib.request.Request(
+                f"{url}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=300) as resp:
+                outs.append(np.asarray(
+                    json.loads(resp.read())["tokens"], np.int32
+                ))
+        sampled = np.asarray(
+            router.complete(prompts[0], 10, temperature=0.7, rng=7,
+                            timeout=300)
+        )
+        with urllib.request.urlopen(f"{url}/metrics", timeout=30) as resp:
+            prom = resp.read().decode()
+        with urllib.request.urlopen(f"{url}/healthz", timeout=30) as resp:
+            health = json.loads(resp.read())
+        snap = router.snapshot()
+        rep_health = router.replica("decode0").fetch_health()
+    for out, ref in zip(outs, refs):
+        if not np.array_equal(out, ref):
+            return fail("migrated output diverged from generate()")
+    if not np.array_equal(sampled, ref_sampled):
+        return fail("sampled migrated output diverged from generate()")
+    if snap["migrations_total"] < len(prompts) + 1:
+        return fail(f"expected migrations, got {snap['migrations_total']}")
+    if snap["kv_migrated_bytes_total"] <= 0:
+        return fail("migrated bytes not metered")
+    for needle in (
+        'router_requests_total{',
+        "router_kv_migrated_bytes_total",
+        'router_replica_healthy{replica="decode0"} 1',
+        'router_replica_slo_attainment{',
+        "router_redistributes_total",
+        "router_migrations_total",
+    ):
+        if needle not in prom:
+            return fail(f"{needle!r} missing from /metrics scrape")
+    if not health["ok"] or health["mode"] != "disagg":
+        return fail(f"router /healthz wrong: {health}")
+    for field in ("role", "queue_depth", "kv_pages_free", "active_slots"):
+        if field not in rep_health:
+            return fail(f"replica /healthz missing {field}")
+    decode_placed = {
+        k: v for k, v in snap["requests_total"].items()
+        if k.startswith("decode/")
+    }
+    if len(decode_placed) != 1:
+        return fail(f"session stickiness broken: {decode_placed}")
+    print(f"# router smoke: {len(prompts) + 1} requests byte-identical "
+          f"through {snap['migrations_total']} migration(s), "
+          f"{snap['kv_migrated_bytes_total']} bytes moved")
+
+    # 4a: replica kill mid-stream -> drain-and-redistribute, outputs
+    # still byte-identical.
+    long_refs = [
+        np.asarray(generate(model, variables, p[None], 28))[0]
+        for p in prompts
+    ]
+    with Router.build(model, variables,
+                      roles=["prefill", "decode", "decode"],
+                      max_batch=2, kv_page_size=8) as router:
+        streams = [router.submit(p, 28) for p in prompts]
+        deadline = time.monotonic() + 120
+        while any(len(s.tokens) < 2 for s in streams):
+            if time.monotonic() > deadline:
+                return fail("streams never started decoding")
+            time.sleep(0.02)
+        router.kill_replica("decode0")
+        outs = [np.asarray(s.result(timeout=300)) for s in streams]
+        snap = router.snapshot()
+    for out, ref in zip(outs, long_refs):
+        if not np.array_equal(out, ref):
+            return fail("redistributed output diverged from generate()")
+    if snap["redistributes_total"] < 1:
+        return fail("kill produced no redistribution")
+    if snap["replica_healthy"]["decode0"] != 0:
+        return fail("killed replica still marked healthy")
+    print(f"# router smoke: replica kill redistributed "
+          f"{snap['redistributes_total']} request(s), all byte-identical")
+
+    # 4b: past the redistribution budget the error is STRUCTURED.
+    with Router.build(model, variables, roles=["prefill", "decode"],
+                      max_batch=2, kv_page_size=8,
+                      router_kwargs={"max_redistributes": 0,
+                                     "admission_retry_s": 2.0},
+                      ) as router:
+        s = router.submit(prompts[0], 40)
+        deadline = time.monotonic() + 120
+        while len(s.tokens) < 2:
+            if time.monotonic() > deadline:
+                return fail("budget leg: stream never started")
+            time.sleep(0.02)
+        router.kill_replica("decode0")
+        try:
+            s.result(timeout=300)
+            return fail("exhausted redistribution budget did not error")
+        except RuntimeError as e:
+            msg = str(e)
+            if "max_redistributes" not in msg:
+                return fail(f"error not structured: {msg}")
+    print("# router smoke: redistribution budget exhaustion is a "
+          "structured client error")
+    print("ROUTER_SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -227,6 +227,33 @@ def test_http_front_end_round_trip(model_and_vars):
         assert line.startswith("#") or " " in line, line
 
 
+def test_health_payload_golden_shape(model_and_vars):
+    """The /healthz payload the router places requests on: the field
+    set (and the placement-critical types) is a compatibility surface —
+    role, queue_depth, kv_pages_free and active_slots must exist with
+    live values on both paged and contiguous servers."""
+    model, variables = model_and_vars
+    with Server(model, variables, max_batch=2, kv_page_size=8,
+                role="decode") as server:
+        server.complete(_prompt(30, 5), 4, timeout=120)
+        payload = server.health()
+    assert sorted(payload) == [
+        "active_requests", "active_slots", "adoptions_pending",
+        "closed", "draining", "healthy", "kv_pages_free",
+        "kv_pages_total", "max_slots", "ok", "queue_depth",
+        "queued_requests", "reason", "role",
+    ]
+    assert payload["ok"] is True and payload["role"] == "decode"
+    assert payload["active_slots"] == 0 and payload["queue_depth"] == 0
+    assert payload["max_slots"] == 2
+    # Paged server: the pool gauges are live numbers the router ranks on.
+    assert payload["kv_pages_total"] == 2 * (64 // 8)
+    assert 0 < payload["kv_pages_free"] <= payload["kv_pages_total"]
+    with Server(model, variables, max_batch=1) as contig:
+        p2 = contig.health()
+    assert p2["role"] == "both" and p2["kv_pages_free"] is None
+
+
 def test_close_fails_inflight_requests_instead_of_hanging(model_and_vars):
     """close() with work still queued/active must fail those streams
     loudly — a blocked result() after shutdown would hang forever."""
