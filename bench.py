@@ -920,6 +920,328 @@ def bench_serve_disagg(n_requests=48, n_tenants=3, shared_frac=0.8,
     return result
 
 
+def bench_serve_chaos(n_requests=96, n_tenants=3, shared_frac=0.8,
+                      mean_interarrival=0.04, shared_len=160,
+                      page_size=16, max_batch=4, seed=0,
+                      ttft_ms=400.0, tpot_ms=1000.0, slo_target=0.9,
+                      pool_factor=3, slow_secs=15.0, out_path=None):
+    """Serving chaos: the recorded 80%-shared-prefix trace, open-loop at
+    saturating load through a 2-prefill + 2-decode router fleet, while
+    1-of-4 replicas is KILLED and another SLOWED mid-run — with and
+    without the mitigation stack (docs/serving.md "Surviving
+    overload"):
+
+    * **baseline**: the PR 13 router as-was — redistribute-on-death
+      only; hedging off, breakers off, no autoscaler, no ladder.
+    * **mitigated**: hedged prefills route around the slow replica,
+      breakers fast-fail it, the SLO-burn autoscaler replaces the dead
+      replica (and may add more / engage the degradation ladder when
+      burn stays high).
+
+    The committed artifact pins: mitigated TTFT attainment >= 2x the
+    baseline under identical chaos, ZERO byte-identity regressions on
+    surviving streams (a degraded stream must equal its un-degraded
+    PREFIX — rungs only clamp budgets, never perturb bytes), zero
+    post-warmup recompiles (compile_watch; the autoscaler's replicas
+    share the compile cache), and every shed/failed request receiving
+    a STRUCTURED error (JSON body over HTTP — status + cause +
+    retry_after for sheds; never a hang, never a stdlib HTML page)."""
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.resilience import faults
+    from ml_trainer_tpu.serving import (
+        Autoscaler, AutoscalerConfig, Router, Server, SloPolicy,
+    )
+    from ml_trainer_tpu.serving.loadgen import (
+        ScheduledRequest, run_open_loop, schedule_from_trace,
+        schedule_to_records,
+    )
+    from ml_trainer_tpu.serving.slo import aggregate_timelines
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    model = get_model("gpt2_tiny", max_len=256)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    rng = np.random.default_rng(seed)
+    prefixes = [
+        rng.integers(0, model.vocab_size, shared_len).astype(np.int32)
+        for _ in range(n_tenants)
+    ]
+    arrivals = np.cumsum(rng.exponential(mean_interarrival, n_requests))
+    trace = []
+    for i in range(n_requests):
+        t = int(rng.integers(0, n_tenants))
+        if rng.random() < shared_frac:
+            suffix = rng.integers(
+                0, model.vocab_size, int(rng.integers(4, 17))
+            ).astype(np.int32)
+            prompt = np.concatenate([prefixes[t], suffix])
+        else:
+            prompt = rng.integers(
+                0, model.vocab_size, int(rng.integers(16, 33))
+            ).astype(np.int32)
+        trace.append(ScheduledRequest(
+            arrival_s=float(arrivals[i]), tenant=f"tenant{t}",
+            prompt=prompt,
+            max_new_tokens=int(rng.choice([8, 48], p=[0.6, 0.4])),
+        ))
+    schedule = schedule_from_trace(schedule_to_records(trace))
+    policy = SloPolicy(ttft_ms=ttft_ms, tpot_ms=tpot_ms,
+                       target=slo_target)
+    pool_pages = pool_factor * max_batch * (256 // page_size) + 1
+    server_kwargs = dict(
+        max_batch=max_batch, kv_page_size=page_size,
+        kv_pages=pool_pages, max_queue=2 * n_requests,
+    )
+    compile_watch.install()
+
+    def build_router(mitigated: bool) -> Router:
+        rk = {"slo": policy, "slo_timelines": 4 * n_requests}
+        if mitigated:
+            # Aggressive hedge clock: the chaos leg's whole point is
+            # routing around a straggler fast.
+            rk.update(hedge_quantile=0.9, hedge_factor=1.2,
+                      hedge_min_s=0.05)
+        else:
+            rk.update(hedging=False, breaker_threshold=None)
+        return Router.build(
+            model, variables,
+            roles=["prefill", "prefill", "decode", "decode"],
+            router_kwargs=rk, **server_kwargs,
+        )
+
+    # Fleet indices are sorted-name order: decode0=0, decode1=1,
+    # prefill0=2, prefill1=3.  Kill decode1, slow prefill0 — one dead,
+    # one straggling, out of four.
+    chaos_spec = (
+        f"replica_kill@step=4,host=1;"
+        f"replica_slow@step=1,host=2,secs={slow_secs}"
+    )
+
+    def warm_continuation_buckets():
+        """Chaos shifts prefix-hit lengths (a redistribute-resume
+        prefills prompt+committed tokens against a survivor's cache),
+        so suffix buckets can appear mid-run that no replay pass
+        visited.  Compile every plausible continuation bucket (8..128)
+        up front — the compile cache is process-wide and keyed on the
+        shared paged-model clone, so all legs (and the autoscaler's
+        mid-run replicas) inherit them."""
+        from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+        from ml_trainer_tpu.serving.scheduler import Request as _Req
+
+        eng = SlotDecodeEngine(
+            model, variables, max_batch=max_batch,
+            kv_page_size=page_size, kv_pages=pool_pages,
+        )
+        wrng = np.random.default_rng(10_000 + seed)
+        base = wrng.integers(0, model.vocab_size, 160).astype(np.int32)
+        for k in (1, 1, 9, 17, 33, 65):  # first k=1 primes the trie
+            prompt = np.concatenate([
+                base, wrng.integers(0, model.vocab_size, k).astype(np.int32)
+            ])
+            req = _Req(prompt=prompt, max_new_tokens=2)
+            if eng.admit(req, 0) == "active":
+                while eng.active_count():
+                    eng.step()
+
+    warm_continuation_buckets()
+
+    # Reference pass (no chaos): warms every compile (prefill buckets,
+    # decode, kv export/import) AND records each request's un-degraded
+    # output — the byte-identity anchor for the chaos legs.
+    with build_router(mitigated=True) as router:
+        host, port = router.serve_http(port=0)
+        url = f"http://{host}:{port}"
+        run_open_loop(schedule, url=url, time_scale=0.0)
+        ref_run = run_open_loop(schedule, url=url, collect_tokens=True)
+    refs = [r.get("output") for r in ref_run["per_request"]]
+    if any(o is None for o in refs):
+        raise RuntimeError(
+            f"reference pass failed: {ref_run['n_errors']} error(s): "
+            f"{ref_run['errors']}"
+        )
+
+    def run_leg(mitigated: bool) -> dict:
+        router = build_router(mitigated)
+        autoscaler = None
+        if mitigated:
+            autoscaler = Autoscaler(
+                router,
+                lambda role: Server(model, variables, role=role,
+                                    **server_kwargs),
+                AutoscalerConfig(
+                    poll_interval_s=0.25, window_s=6.0,
+                    min_window_requests=6, burn_high=1.5,
+                    high_polls=2, cooldown_s=2.0, max_replicas=6,
+                    min_prefill=2, min_decode=2, scale_down=False,
+                ),
+            ).start()
+        err = None
+        try:
+            host, port = router.serve_http(port=0)
+            url = f"http://{host}:{port}"
+            # One untimed fault-free pass AT REAL TIME: prefix caches,
+            # replica health and the hedging clock to steady state —
+            # chaos hits a WARM fleet, and the hedge clock reflects
+            # healthy first-result latency, not compressed-burst queues.
+            run_open_loop(schedule, url=url)
+            timed_t0 = time.monotonic()
+            with faults.injected(chaos_spec):
+                try:
+                    with compile_watch.expect_no_compiles(
+                        f"serve-chaos {'mitigated' if mitigated else 'baseline'}"
+                    ):
+                        client = run_open_loop(
+                            schedule, url=url, collect_tokens=True,
+                            timeout=180.0,
+                        )
+                except AssertionError as e:
+                    err = str(e)
+                    client = run_open_loop(
+                        schedule, url=url, collect_tokens=True,
+                        timeout=180.0,
+                    )
+            server_side = aggregate_timelines(
+                router.slo.timelines(since=timed_t0), policy
+            )
+            snap = router.snapshot()
+            asc_summary = (
+                autoscaler.summary() if autoscaler is not None else None
+            )
+        finally:
+            if autoscaler is not None:
+                autoscaler.close()
+            router.close()
+        # Byte identity on surviving streams: a completed (possibly
+        # budget-clamped) output must equal its un-degraded PREFIX.
+        identity_bad = 0
+        for row, ref in zip(client["per_request"], refs):
+            out = row.get("output")
+            if not row["ok"] or out is None:
+                continue
+            if len(out) > len(ref) or out != ref[: len(out)]:
+                identity_bad += 1
+        # Structured-failure audit: every failed row must carry a JSON
+        # error body (status + cause), sheds a retry_after.
+        failed = [r for r in client["per_request"] if not r["ok"]]
+        unstructured = [
+            r for r in failed
+            if not (r.get("structured") or "retry after" in (r.get("error") or ""))
+        ]
+        leg = {
+            "mitigated": mitigated,
+            "tokens_per_sec": client["tokens_per_sec"],
+            "makespan_s": client["makespan_s"],
+            "n_completed": client["n_completed"],
+            "n_errors": client["n_errors"],
+            "n_shed": sum(
+                1 for r in failed if r.get("retry_after") is not None
+            ),
+            "unstructured_failures": len(unstructured),
+            "identity_regressions": identity_bad,
+            "ttft_p50_ms": server_side["ttft_ms"]["p50"],
+            "ttft_p99_ms": server_side["ttft_ms"]["p99"],
+            "ttft_attainment": server_side["attainment"]["ttft"],
+            "tpot_attainment": server_side["attainment"]["tpot"],
+            "n_timelines": server_side["n_requests"],
+            "migrations": snap["migrations_total"],
+            "migrations_corrupt": snap["migrations_corrupt_total"],
+            "redistributes": snap["redistributes_total"],
+            "hedges": snap["hedges_total"],
+            "hedge_wins": snap["hedge_wins_total"],
+            "flaps_damped": snap["flaps_damped_total"],
+            "shed_total": snap["shed_total"],
+            "degradation": snap["degradation"],
+            "zero_recompiles": err is None,
+        }
+        if err is not None:
+            leg["recompile_error"] = err
+        if asc_summary is not None:
+            leg["autoscaler"] = asc_summary
+        print(
+            f"# serve chaos [{'mitigated' if mitigated else ' baseline'}]: "
+            f"TTFT attainment {leg['ttft_attainment']:.3f} "
+            f"(p99 {leg['ttft_p99_ms']} ms), "
+            f"{leg['n_completed']}/{n_requests} completed, "
+            f"{leg['hedges']} hedge(s), {leg['redistributes']} "
+            f"redistribute(s), {leg['identity_regressions']} identity "
+            f"regression(s)" + ("" if err is None else "  [RECOMPILED]"),
+            flush=True,
+        )
+        return leg
+
+    baseline = run_leg(mitigated=False)
+    mitigated = run_leg(mitigated=True)
+    ratio = round(
+        mitigated["ttft_attainment"] / max(baseline["ttft_attainment"],
+                                           0.01), 3
+    )
+    result = {
+        "baseline": baseline,
+        "mitigated": mitigated,
+        "attainment_ratio": ratio,
+        "attainment_win_2x": bool(ratio >= 2.0),
+        "byte_identity_ok": (
+            baseline["identity_regressions"] == 0
+            and mitigated["identity_regressions"] == 0
+        ),
+        "zero_recompiles": bool(
+            baseline["zero_recompiles"] and mitigated["zero_recompiles"]
+        ),
+        "all_failures_structured": (
+            baseline["unstructured_failures"] == 0
+            and mitigated["unstructured_failures"] == 0
+        ),
+        "chaos": chaos_spec,
+        "slo": {"ttft_ms": ttft_ms, "tpot_ms": tpot_ms,
+                "target": slo_target},
+        "n_requests": n_requests,
+        "n_tenants": n_tenants,
+        "shared_frac": shared_frac,
+        "shared_len": shared_len,
+        "page_size": page_size,
+        "max_batch": max_batch,
+        "seed": seed,
+        "backend": jax.default_backend(),
+        # run_report-style summary: what acted, when, and what it cost.
+        "run_report": {
+            "fleet": "2 prefill + 2 decode (decode1 killed, "
+                     "prefill0 slowed)",
+            "mitigations": ["hedged prefills", "circuit breakers",
+                            "SLO-burn autoscaler", "degradation ladder"],
+            "autoscaler_actions": (
+                mitigated.get("autoscaler") or {}
+            ).get("counts", {}),
+            "ladder_transitions": mitigated["degradation"]["transitions"],
+            "attainment": {
+                "baseline": baseline["ttft_attainment"],
+                "mitigated": mitigated["ttft_attainment"],
+                "ratio": ratio,
+            },
+        },
+    }
+    if not result["byte_identity_ok"]:
+        result["error"] = "surviving streams diverged from reference"
+    elif not result["zero_recompiles"]:
+        result["error"] = "compiles observed during a chaos leg"
+    elif not result["all_failures_structured"]:
+        result["error"] = (
+            f"unstructured failures: baseline "
+            f"{baseline['unstructured_failures']}, mitigated "
+            f"{mitigated['unstructured_failures']}"
+        )
+    elif not result["attainment_win_2x"]:
+        result["error"] = (
+            f"mitigated attainment only {ratio}x baseline (need >= 2x)"
+        )
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# serve chaos artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_spec(b=2, pattern_len=8, prompt_len=64, new_tokens=128,
                draft_k=8, reps=2, seed=0):
     """Speculative-decoding leg: tokens/s of the speculative loop
@@ -2087,6 +2409,17 @@ def main():
                         "replicas; byte identity + zero recompiles "
                         "pinned; writes docs/serving_disagg_cpu.json "
                         "(gpt2_tiny; CPU-safe)")
+    parser.add_argument("--serve-chaos", action="store_true",
+                        help="run only the serving-chaos leg: the recorded "
+                        "80%%-shared-prefix trace open-loop at saturating "
+                        "load through a 2-prefill+2-decode router while "
+                        "1-of-4 replicas is killed and another slowed "
+                        "mid-run, with vs without the mitigation stack "
+                        "(SLO-burn autoscaler + hedged prefills + circuit "
+                        "breakers + degradation ladder); attainment >= 2x "
+                        "baseline, byte identity, zero recompiles and "
+                        "structured failures pinned; writes "
+                        "docs/serving_chaos_cpu.json (gpt2_tiny; CPU-safe)")
     parser.add_argument("--mixed", action="store_true",
                         help="run only the mixed-precision / sharded-update "
                         "matrix: {fp32,bf16} x {fused-psum, bucketed "
@@ -2236,6 +2569,21 @@ def main():
         )
         result = bench_serve_disagg(out_path=out)
         print(json.dumps({"serve_disagg": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.serve_chaos:
+        # Serving fleet under chaos (kill + slow) with vs without the
+        # mitigation stack; the artifact is the acceptance evidence for
+        # the overload subsystem and feeds bench_gate.py gate_overload.
+        import os as _os
+
+        out = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "serving_chaos_cpu.json",
+        )
+        result = bench_serve_chaos(out_path=out)
+        print(json.dumps({"serve_chaos": result}))
         if result.get("error"):
             sys.exit(1)
         return

@@ -33,17 +33,40 @@ TPU job fails in:
                       straggler for telemetry/cluster.py to catch); the
                       single-process simulation treats it as a
                       straggler verdict and reshapes.
+* ``replica_kill``  — serving chaos: the router's health poller kills
+                      the replica at fleet index ``host`` at the
+                      matching busy poll — watchdog-style death, the
+                      drain-and-redistribute path under real load.
+* ``replica_slow``  — serving chaos: the matching replica's serve loop
+                      latches a slow-down window of ``secs`` seconds
+                      (every loop iteration sleeps) once it is busy —
+                      a throttled/straggling replica the hedging and
+                      breaker machinery must route around.
+* ``healthz_flap``  — serving chaos: ONE health poll against the
+                      matching replica looks dropped (transient
+                      timeout); the router's flap damping must absorb
+                      it without a spurious drain-and-redistribute.
+* ``migration_corrupt`` — serving chaos: the next KV migration payload
+                      through the router has one bit flipped in
+                      flight; the CRC32 verify on import must refuse
+                      it and the router retry on a fallback candidate.
 
 Spec syntax (also accepted via the ``ML_TRAINER_TPU_FAULTS`` env var)::
 
     nan_grad@step=12;ckpt_truncate@epoch=1;preempt@step=40;decode_wedge@step=5
     host_kill@step=9,host=1
+    replica_kill@step=3,host=2;replica_slow@step=1,host=0,secs=8
 
 Entries are ``kind@key=value[,key=value...]`` separated by ``;``.
 Trigger keys: ``step`` (1-based train/decode step) or ``epoch``.
 Params: ``count`` (consecutive steps to fire on, default 1), ``secs``
-(wedge/hang hold bound, default 300), and ``host`` (the host index a
-``host_kill``/``host_hang`` names, default 0).
+(wedge/hang/slow hold bound, default 300), and ``host`` (the host index
+a ``host_kill``/``host_hang`` names — or the replica fleet index for
+the serving kinds; default 0).  Serving hooks pass their own replica
+index to ``fire(..., host=)``, so a fault naming ``host=2`` only fires
+in replica 2's hook (host-filtered matching); the trainer's host_kill
+flow keeps its original semantics — the hook omits ``host=`` and
+checks ``fault.host`` itself.
 
 Every hook is a no-op when no plan is active, and every fault fires a
 bounded number of times — injection is reproducible, never ambient.
@@ -62,7 +85,14 @@ from typing import List, Optional
 ENV_VAR = "ML_TRAINER_TPU_FAULTS"
 
 KINDS = ("nan_grad", "preempt", "ckpt_truncate", "decode_wedge",
-         "decode_error", "host_kill", "host_hang")
+         "decode_error", "host_kill", "host_hang",
+         "replica_kill", "replica_slow", "healthz_flap",
+         "migration_corrupt")
+
+# Kinds whose ``host`` param names a target (pod host index, or the
+# serving fleet's replica index for the serving chaos kinds).
+HOSTED_KINDS = ("host_kill", "host_hang", "replica_kill", "replica_slow",
+                "healthz_flap", "migration_corrupt")
 
 
 @dataclass
@@ -78,8 +108,14 @@ class Fault:
     host: int = 0  # the host index a host_kill/host_hang names
     fired: int = 0
 
-    def matches(self, step: Optional[int], epoch: Optional[int]) -> bool:
+    def matches(self, step: Optional[int], epoch: Optional[int],
+                host: Optional[int] = None) -> bool:
         if self.fired >= self.count:
+            return False
+        if host is not None and self.host != host:
+            # Host-filtered matching: a serving hook names its own
+            # replica index, so a fault targeting host=2 never consumes
+            # a firing in replica 0's hook.
             return False
         if self.step is not None:
             return step is not None and (
@@ -97,7 +133,7 @@ class Fault:
             parts.append(f"epoch={self.epoch}")
         if self.count != 1:
             parts.append(f"count={self.count}")
-        if self.kind in ("host_kill", "host_hang"):
+        if self.kind in HOSTED_KINDS:
             parts.append(f"host={self.host}")
         return self.kind + ("@" + ",".join(parts) if parts else "")
 
@@ -146,20 +182,22 @@ class FaultPlan:
                         "expected step|epoch|count|secs|host"
                     )
                 kwargs[key] = float(value) if key == "secs" else int(value)
-            if "host" in kwargs and kind not in ("host_kill", "host_hang"):
+            if "host" in kwargs and kind not in HOSTED_KINDS:
                 raise ValueError(
-                    f"'host' only applies to host_kill/host_hang faults "
-                    f"(got it on {kind!r} in {entry!r})"
+                    f"'host' only applies to host/replica-targeted "
+                    f"faults {sorted(HOSTED_KINDS)} (got it on {kind!r} "
+                    f"in {entry!r})"
                 )
             faults.append(Fault(kind=kind, **kwargs))
         return cls(faults)
 
     def fire(self, kind: str, *, step: Optional[int] = None,
-             epoch: Optional[int] = None) -> Optional[Fault]:
+             epoch: Optional[int] = None,
+             host: Optional[int] = None) -> Optional[Fault]:
         with self._lock:
             fired = None
             for fault in self.faults:
-                if fault.kind == kind and fault.matches(step, epoch):
+                if fault.kind == kind and fault.matches(step, epoch, host):
                     fault.fired += 1
                     fired = fault
                     break

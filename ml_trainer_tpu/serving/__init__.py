@@ -26,8 +26,16 @@ transfer.py, docs/serving.md "Disaggregated serving"):
 """
 
 from ml_trainer_tpu.serving.api import Server, TokenStream
+from ml_trainer_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
 from ml_trainer_tpu.serving.engine import SlotDecodeEngine
 from ml_trainer_tpu.serving.kv_pool import KVPagePool
+from ml_trainer_tpu.serving.overload import (
+    CircuitBreaker,
+    DegradationConfig,
+    DegradationLadder,
+    OverloadShed,
+    RollingQuantile,
+)
 from ml_trainer_tpu.serving.metrics import ServingMetrics
 from ml_trainer_tpu.serving.prefix_cache import PrefixCache
 from ml_trainer_tpu.serving.scheduler import (
@@ -51,12 +59,21 @@ from ml_trainer_tpu.serving.loadgen import (
 from ml_trainer_tpu.serving.router import Router
 from ml_trainer_tpu.serving.transfer import (
     KVSlotExport,
+    MigrationCorrupt,
     export_kv_slot,
     import_kv_slot,
 )
 
 __all__ = [
     "Router",
+    "Autoscaler",
+    "AutoscalerConfig",
+    "CircuitBreaker",
+    "DegradationConfig",
+    "DegradationLadder",
+    "MigrationCorrupt",
+    "OverloadShed",
+    "RollingQuantile",
     "KVSlotExport",
     "export_kv_slot",
     "import_kv_slot",

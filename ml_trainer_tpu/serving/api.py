@@ -55,6 +55,7 @@ import numpy as np
 
 from ml_trainer_tpu.serving.engine import SlotDecodeEngine
 from ml_trainer_tpu.serving.metrics import ServingMetrics
+from ml_trainer_tpu.serving.overload import DegradationConfig, OverloadShed
 from ml_trainer_tpu.serving.scheduler import (
     AdmissionError,
     DeadlineExceeded,
@@ -92,6 +93,11 @@ class TokenStream:
     def _raise_on_failure(self):
         if self._req.state == "expired":
             raise DeadlineExceeded(self._req.error or "deadline exceeded")
+        if self._req.state == "shed":
+            raise OverloadShed(
+                self._req.error or "request shed under overload",
+                retry_after=self._req.retry_after,
+            )
         if self._req.state == "error":
             raise RuntimeError(self._req.error or "serving engine error")
 
@@ -233,6 +239,20 @@ class Server:
         # drained by the loop thread.  Plain deque — single consumer
         # (the loop), producers only append; both ends are atomic.
         self._adoptions: collections.deque = collections.deque()
+        # Overload control (serving/overload.py): the active
+        # degradation-ladder rung + config mirror the ladder applies;
+        # level 0 is full service.
+        self._degradation_level = 0
+        self._degradation_cfg: Optional[DegradationConfig] = None
+        # Router plumbing: the fleet index (chaos faults name replicas
+        # by it), the slow-down latch the replica_slow fault arms, and
+        # the evacuation sink a role reassignment installs (the loop
+        # thread exports every active slot's KV through it).
+        self.replica_index = 0
+        self._slow_until = 0.0
+        self._busy_iters = 0
+        self._evacuate_sink = None
+        self._evacuated = threading.Event()
         self._httpd = None
         self._http_thread = None
         self._thread = threading.Thread(
@@ -323,6 +343,28 @@ class Server:
                 "server is draining: admission stopped, in-flight "
                 "requests are finishing"
             )
+        # Degradation rungs act at SUBMISSION only (serving/overload.py):
+        # a request already carrying committed tokens is a resume /
+        # redistribution of a running stream and is never clamped or
+        # shed — the byte-identity contract.
+        level, cfg = self._degradation_level, self._degradation_cfg
+        if level and cfg is not None and not req.tokens:
+            if level >= 4 and req.priority < cfg.shed_below_priority:
+                self.metrics.record_shed(req.tenant)
+                raise OverloadShed(
+                    f"request {req.id} (tenant '{req.tenant}', priority "
+                    f"{req.priority}) shed at admission: degradation "
+                    f"rung shed_queued rejects priority < "
+                    f"{cfg.shed_below_priority}; retry after "
+                    f"{cfg.retry_after_s}s",
+                    retry_after=cfg.retry_after_s,
+                )
+            if req.max_new_tokens > cfg.clamp_tokens:
+                req.max_new_tokens = cfg.clamp_tokens
+                req.mark(
+                    "degraded_clamp", level=level,
+                    clamp=cfg.clamp_tokens,
+                )
         # Observer installed BEFORE the enqueue so every terminal path —
         # including queued-expiry inside the scheduler — lands in the
         # SLO accounting; a rejected submit never enqueues, so its
@@ -365,6 +407,64 @@ class Server:
             timeout=timeout
         )
 
+    # -- overload control (serving/overload.py) ---------------------------
+
+    def set_degradation(self, level: int,
+                        config: Optional[DegradationConfig] = None) -> None:
+        """Apply a degradation-ladder rung (thread-safe, idempotent):
+        0 full service, 1 clamp fresh token budgets, 2 speculative
+        decode off, 3 prefix-cache hits only, 4 shed low-priority.
+        Effects hit NEW admissions only; running streams finish
+        undegraded (tests/test_overload.py pins the byte identity)."""
+        cfg = config if config is not None else DegradationConfig()
+        self._degradation_cfg = cfg
+        self._degradation_level = int(level)
+        eng = self.engine
+        eng.degradation_level = int(level)
+        eng.shed_retry_after = cfg.retry_after_s
+        eng.spec_enabled = int(level) < 2
+
+    def shed_queued(self, below_priority: int, retry_after: float,
+                    cause: str = "overload") -> int:
+        """Shed this server's queued requests below ``below_priority``
+        (the ladder's rung-4 entry action); returns the count."""
+        return self.scheduler.shed_queued(
+            below_priority, retry_after, cause=cause
+        )
+
+    def cancel(self, req: Request) -> None:
+        """Withdraw a request this server no longer needs to serve (the
+        hedging loser, serving/router.py): the SLO tracker forgets it
+        (a cancelled duplicate is not an SLO miss), the observer is
+        cleared, and the loop thread drops it at the next boundary —
+        queued entries never admit, active slots release with their
+        pages donated."""
+        self.slo.forget(req)
+        req.observer = None
+        req.cancel_requested = True
+        self._wake.set()
+
+    def evacuate(self, sink, timeout: float = 30.0) -> bool:
+        """Drain this replica THROUGH the migration machinery (role
+        reassignment, serving/autoscaler.py): the loop thread exports
+        every active slot's KV and hands ``(request, export)`` to
+        ``sink`` — the router adopts each onto another replica, so the
+        streams keep flowing with their pages instead of re-prefilling —
+        and every queued request fails with a retryable ``draining``
+        error the router redistributes.  Blocks (up to ``timeout``)
+        until the loop thread finished the sweep; returns True when it
+        did.  The server stays healthy and keeps serving afterwards —
+        the caller controls placement."""
+        if not self.engine.paged:
+            raise ValueError(
+                "evacuate needs a paged engine: the page chain is the "
+                "migration unit (kv_page_size > 0)"
+            )
+        self._evacuated.clear()
+        self._evacuate_sink = sink
+        self._wake.set()
+        return self._evacuated.wait(timeout=timeout)
+
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Graceful drain: stop admission (``submit`` raises
         ``AdmissionError``) and block until every queued + in-flight
@@ -393,6 +493,20 @@ class Server:
         ``queue_depth``, ``kv_pages_free``, ``active_slots`` — instead
         of round-robin; the shape is pinned by a golden test in
         tests/test_serving.py."""
+        from ml_trainer_tpu.resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is not None:
+            # healthz_flap chaos: ONE poll looks dropped (the payload
+            # says why) — the router's flap damping must absorb it
+            # without a spurious drain-and-redistribute.
+            fault = plan.fire("healthz_flap", host=self.replica_index)
+            if fault is not None:
+                return {
+                    "ok": False, "healthy": False, "draining": False,
+                    "closed": False, "flap": True,
+                    "reason": "injected healthz flap (transient)",
+                }
         engine = self.engine
         return {
             "ok": self.healthy and not self._draining and not self._stopping,
@@ -407,6 +521,7 @@ class Server:
             "queued_requests": self.scheduler.queue_depth(),
             "queue_depth": self.scheduler.queue_depth(),
             "adoptions_pending": len(self._adoptions),
+            "degradation_level": self._degradation_level,
             "kv_pages_free": (
                 engine.pool.free_count() if engine.paged else None
             ),
@@ -568,6 +683,11 @@ class Server:
                 self.metrics.record_expiry()
                 progressed = True
                 continue
+            if req.cancel_requested:
+                req.finish("error", "cancelled: hedge superseded")
+                self.metrics.record_cancellation()
+                progressed = True
+                continue
             slot = sched.acquire_direct(req)
             if slot is None:
                 # No free slot right now: park it at the head so the
@@ -578,8 +698,26 @@ class Server:
             # visible to the watchdog/error handler (the request is not
             # in engine._active yet) and fails its stream instead of
             # hanging the client.
+            from ml_trainer_tpu.serving.transfer import MigrationCorrupt
+
             self._admitting_req = req
-            status = engine.import_slot(req, slot, export)
+            try:
+                status = engine.import_slot(req, slot, export)
+            except MigrationCorrupt as e:
+                # The payload failed its CRC gate AT import (the router
+                # verifies at deserialization, so this is the last
+                # line): refuse the pages, fall back to the ordinary
+                # requeue-and-reprefill resume — never adopt garbage,
+                # never poison the loop.
+                self._admitting_req = None
+                sched.release(slot)
+                req.mark("adopt_corrupt", error=str(e))
+                self._log.error(
+                    "serving_adopt_corrupt", request=req.id, error=str(e)
+                )
+                sched.requeue(req)
+                progressed = True
+                continue
             self._admitting_req = None
             if status == "no_memory":
                 sched.release(slot)
@@ -618,11 +756,99 @@ class Server:
                 f"kv migration sink failed: {type(e).__name__}: {e}",
             )
 
+    def _fault_hooks(self) -> None:
+        """Serving chaos injection (resilience/faults.py): a matching
+        ``replica_slow`` fault latches a slow-down window — every loop
+        iteration inside it sleeps, the in-process analog of a replica
+        whose chips are being throttled.  The busy-iteration counter is
+        the trigger clock, so the fault fires while the replica is
+        actually serving, not while it idles."""
+        from ml_trainer_tpu.resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is None:
+            return
+        busy = (
+            self.engine.active_count() > 0
+            or self.scheduler.queue_depth() > 0
+            or len(self._adoptions) > 0
+        )
+        if busy:
+            self._busy_iters += 1
+            fault = plan.fire(
+                "replica_slow", step=self._busy_iters,
+                host=self.replica_index,
+            )
+            if fault is not None:
+                self._slow_until = time.monotonic() + fault.secs
+        self._maybe_slow()
+
+    def _maybe_slow(self) -> None:
+        """Inside a ``replica_slow`` window every dispatch (admission,
+        decode step, loop pass) pays ~0.5s — a brutally throttled
+        replica whose queue genuinely GROWS under load, which the
+        hedging/breaker/autoscaler machinery must route around, not
+        wait politely for."""
+        if time.monotonic() < self._slow_until:
+            time.sleep(0.5)
+
+    def _run_evacuation(self) -> None:
+        """Role-reassignment drain (loop thread only): export every
+        active slot through the migration machinery to the installed
+        sink, hand pending adoptions along with their exports, and fail
+        queued requests with a retryable ``draining`` error the router
+        redistributes.  The replica is empty (and still healthy) when
+        this returns."""
+        sink, self._evacuate_sink = self._evacuate_sink, None
+        engine, sched = self.engine, self.scheduler
+        for slot in sorted(engine._active):
+            req = engine._active[slot]
+            export = engine.export_slot(slot)
+            engine._active.pop(slot, None)
+            engine._release_slot_pages(slot, req, donate=True)
+            sched.release(slot)
+            # The adopting replica's tracker takes over (Server.adopt).
+            self.slo.forget(req)
+            req.mark("evacuated", slot=slot, pages=export.n_pages)
+            try:
+                sink(req, export)
+            except Exception as e:  # noqa: BLE001 — the sink is router code
+                req.finish(
+                    "error",
+                    f"replica draining for role reassignment; evacuation "
+                    f"sink failed: {type(e).__name__}: {e}",
+                )
+        while self._adoptions:
+            try:
+                req, export = self._adoptions.popleft()
+            except IndexError:
+                break
+            self.slo.forget(req)
+            try:
+                sink(req, export)
+            except Exception as e:  # noqa: BLE001
+                req.finish(
+                    "error",
+                    f"replica draining for role reassignment; evacuation "
+                    f"sink failed: {type(e).__name__}: {e}",
+                )
+        for req in sched.drain_pending():
+            self.slo.forget(req)
+            req.finish(
+                "error",
+                "replica draining for role reassignment: request "
+                "redistributed",
+            )
+        self._evacuated.set()
+
     def _loop_inner(self) -> None:
         engine, sched = self.engine, self.scheduler
         while not self._stopping and self.healthy:
             self._last_beat = time.monotonic()
             try:
+                self._fault_hooks()
+                if self._evacuate_sink is not None:
+                    self._run_evacuation()
                 # Adoptions first: they already spent a prefill on
                 # another replica — making them wait behind fresh
                 # admissions would waste that work under load.
@@ -632,6 +858,7 @@ class Server:
                     if got is None:
                         break
                     req, slot = got
+                    self._maybe_slow()
                     # Tracked so a wedge or crash DURING prefill (request
                     # popped from the queue, not yet in engine._active)
                     # is still visible to the watchdog/error handler and
@@ -652,6 +879,7 @@ class Server:
                     elif status == "active" and req.migration_sink is not None:
                         self._export_for_migration(req, slot)
                 if engine.active_count():
+                    self._maybe_slow()
                     for slot in engine.step():
                         sched.release(slot)
                     # Preempt-and-requeue victims resume from their
@@ -707,11 +935,17 @@ class Server:
             def log_message(self, *args):  # quiet: we have metrics
                 pass
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict,
+                      retry_after: Optional[float] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(round(retry_after)))),
+                    )
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -780,17 +1014,32 @@ class Server:
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
+                    deadline = body.get("deadline")
                     out = server.complete(
                         np.asarray(body["prompt"], np.int32),
                         int(body.get("max_new_tokens", 16)),
                         temperature=float(body.get("temperature", 0.0)),
                         rng=body.get("seed"),
                         eos_token_id=body.get("eos_token_id"),
-                        deadline=body.get("deadline"),
+                        deadline=deadline,
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)),
+                        # The HTTP wait is capped by the client's own
+                        # deadline (plus engine slack): a deadline'd
+                        # request gets its 504 near the deadline even
+                        # when the engine misbehaves.
+                        timeout=(
+                            float(deadline) + 30.0
+                            if deadline is not None else None
+                        ),
                     )
                     self._send(200, {"tokens": [int(t) for t in out]})
+                except OverloadShed as e:
+                    payload = {"error": str(e)}
+                    if e.retry_after is not None:
+                        payload["retry_after"] = e.retry_after
+                    self._send(503, payload,
+                               retry_after=e.retry_after)
                 except AdmissionError as e:
                     self._send(429, {"error": str(e)})
                 except EngineUnhealthy as e:
@@ -800,6 +1049,11 @@ class Server:
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                except RuntimeError as e:
+                    # Structured terminal errors (redistribution budget,
+                    # engine give-ups) must reach the client as JSON,
+                    # never a stdlib 500 HTML page.
+                    self._send(503, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(

@@ -230,6 +230,15 @@ class SlotDecodeEngine:
         self._steps = np.zeros((max_batch,), np.int32)
         self._active: Dict[int, Request] = {}
         self._step_seq = 0  # decode steps run (the decode_wedge fault clock)
+        # Overload control (serving/overload.py, set via
+        # Server.set_degradation): the active degradation-ladder rung
+        # (0 = full service), the retry_after a shed client is told,
+        # and whether speculative decode is enabled (rung 2 turns it
+        # off WITHOUT recompiling — the vanilla decode program always
+        # exists, and greedy streams are byte-identical either way).
+        self.degradation_level = 0
+        self.shed_retry_after = 2.0
+        self.spec_enabled = True
         # Telemetry: flight ring for crash forensics (the watchdog dumps
         # it when the loop wedges) and the on-demand profile window the
         # admin endpoint arms (POST /admin/profile).
@@ -723,6 +732,26 @@ class SlotDecodeEngine:
                     record=not req.kv_blocked,
                 )
                 req.prefix_hit_tokens = c
+            if (
+                self.degradation_level >= 3 and c == 0
+                and done_tokens == 0 and self._prefix is not None
+            ):
+                # Rung 3 (hits_only): a FRESH prefix-cache miss is shed
+                # with a structured 503 instead of spending a full
+                # prefill the fleet cannot afford.  Resumes/preempted
+                # requests (committed tokens) are never shed — the
+                # byte-identity contract for running streams.
+                if shared:
+                    self.pool.release(shared)
+                req.retry_after = self.shed_retry_after
+                req.finish(
+                    "shed",
+                    f"request {req.id} (tenant '{req.tenant}') shed: "
+                    "degradation rung hits_only admits prefix-cache "
+                    f"hits only; retry after {self.shed_retry_after}s",
+                )
+                self.metrics.record_shed(req.tenant)
+                return "finished"
             # Cover the prompt plus the first decode window so a fresh
             # admission cannot immediately trigger preemption.
             total_need = self.pool.pages_for(
@@ -918,13 +947,34 @@ class SlotDecodeEngine:
             del self._active[req.slot]
         return done
 
+    def _sweep_cancelled(self) -> List[int]:
+        """Release slots whose request was cancelled (a hedging loser,
+        serving/router.py): the router already stopped reading the
+        stream and cleared the SLO observer, so the finish is purely a
+        release — pages donated (the prefill work stays useful in the
+        prefix cache), slot freed before the next dispatch wastes a
+        step on it."""
+        freed: List[int] = []
+        for slot in [
+            s for s, r in self._active.items() if r.cancel_requested
+        ]:
+            req = self._active.pop(slot)
+            req.finish("error", "cancelled: hedge superseded")
+            self.metrics.record_cancellation()
+            self._release_slot_pages(slot, req, donate=True)
+            freed.append(slot)
+        return freed
+
     def step(self) -> List[int]:
         """One compiled decode step over all slots; distributes each
         active slot's token(s) and returns the slots freed this step
-        (finished, expired, or preempted).  In spec mode each slot
-        advances 1..spec_k+1 tokens."""
+        (finished, expired, cancelled, or preempted).  In spec mode
+        each slot advances 1..spec_k+1 tokens."""
         if not self._active:
             return []
+        cancel_freed = self._sweep_cancelled()
+        if not self._active:
+            return cancel_freed
         self._step_seq += 1
         # Flight record BEFORE the dispatch: when this step wedges, the
         # ring's newest decode_step record names the step — and the
@@ -946,15 +996,16 @@ class SlotDecodeEngine:
             fault = plan.fire("decode_wedge", step=self._step_seq)
             if fault is not None:
                 plan.hold_wedge(fault)
-        preempt_freed: List[int] = []
+        spec_now = bool(self.spec_k and self.spec_enabled)
+        preempt_freed: List[int] = cancel_freed
         if self.paged:
-            preempt_freed = self._ensure_pages(
-                self.spec_k + 1 if self.spec_k else 1
+            preempt_freed = preempt_freed + self._ensure_pages(
+                self.spec_k + 1 if spec_now else 1
             )
             self._sync_table()
             if not self._active:
                 return preempt_freed
-        if self.spec_k:
+        if spec_now:
             return preempt_freed + self._step_spec()
         active_before = len(self._active)
         t0 = time.perf_counter()

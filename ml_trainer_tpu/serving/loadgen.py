@@ -37,6 +37,7 @@ import dataclasses
 import json
 import threading
 import time
+import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence
 
@@ -235,6 +236,22 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
                 if collect_tokens:
                     row["output"] = [int(t) for t in np.asarray(out)]
             row["ok"] = True
+        except urllib.error.HTTPError as e:
+            # A STRUCTURED refusal (shed/backpressure/deadline) carries
+            # a JSON body naming the cause — keep it, plus the status
+            # and retry_after, so the chaos harness can prove every
+            # failed request got a structured error, not a hang or a
+            # stdlib HTML page.
+            row["status"] = e.code
+            try:
+                body = json.loads(e.read())
+                row["error"] = body.get("error") or f"HTTP {e.code}"
+                if "retry_after" in body:
+                    row["retry_after"] = body["retry_after"]
+                row["structured"] = bool(body.get("error"))
+            except Exception:
+                row["error"] = f"HTTPError: HTTP {e.code}"
+                row["structured"] = False
         except Exception as e:  # the harness reports failures, it
             row["error"] = f"{type(e).__name__}: {e}"  # never dies on one
         row["latency_s"] = round(time.monotonic() - sent_at, 6)

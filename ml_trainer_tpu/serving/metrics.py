@@ -103,6 +103,11 @@ class ServingMetrics:
         self.requests_rejected = 0
         self.requests_completed = 0
         self.requests_expired = 0
+        # Overload control (serving/overload.py): requests shed by the
+        # degradation ladder (structured 503 + retry_after), and
+        # hedging losers cancelled after their duplicate won.
+        self.requests_shed = 0
+        self.requests_cancelled = 0
         # Resilience counters: engine-loop exceptions survived, and
         # watchdog wedge detections (each of which failed all in-flight
         # requests and poisoned the server).
@@ -243,6 +248,19 @@ class ServingMetrics:
         with self._lock:
             self.requests_expired += 1
 
+    def record_shed(self, tenant: Optional[str] = None) -> None:
+        """One request shed by the degradation ladder (overload.py)."""
+        with self._lock:
+            self.requests_shed += 1
+            if tenant is not None:
+                t = self._tenant(tenant)
+                t["shed"] = t.get("shed", 0) + 1
+
+    def record_cancellation(self) -> None:
+        """One hedging loser dropped after its duplicate won."""
+        with self._lock:
+            self.requests_cancelled += 1
+
     def record_engine_error(self) -> None:
         with self._lock:
             self.engine_errors += 1
@@ -372,6 +390,8 @@ class ServingMetrics:
                 "requests_rejected": self.requests_rejected,
                 "requests_completed": self.requests_completed,
                 "requests_expired": self.requests_expired,
+                "requests_shed": self.requests_shed,
+                "requests_cancelled": self.requests_cancelled,
                 "engine_errors": self.engine_errors,
                 "watchdog_trips": self.watchdog_trips,
                 "kv_pages_total": self.kv_pages_total,

@@ -72,6 +72,13 @@ import numpy as np
 
 from ml_trainer_tpu.serving import transfer
 from ml_trainer_tpu.serving.api import Server, TokenStream
+from ml_trainer_tpu.serving.overload import (
+    CircuitBreaker,
+    DegradationConfig,
+    DegradationLadder,
+    OverloadShed,
+    RollingQuantile,
+)
 from ml_trainer_tpu.serving.scheduler import (
     AdmissionError,
     EngineUnhealthy,
@@ -79,6 +86,7 @@ from ml_trainer_tpu.serving.scheduler import (
     _DONE,
 )
 from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
+from ml_trainer_tpu.serving.transfer import MigrationCorrupt
 from ml_trainer_tpu.utils.logging import get_logger
 
 # Stream sentinel kind the migration sink pushes between tokens: the
@@ -92,7 +100,8 @@ class Replica:
     plus its routing state (role, last health payload, liveness)."""
 
     def __init__(self, name: str, server: Server,
-                 url: Optional[str] = None):
+                 url: Optional[str] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.name = name
         self.server = server
         self.url = url
@@ -103,6 +112,14 @@ class Replica:
         # is a quarter-second stale under burst arrivals, so without
         # this every tie lands on the same replica until the next poll.
         self.pending = 0
+        # Client-path hardening (serving/overload.py): the per-replica
+        # circuit breaker (K consecutive failures open it — the router
+        # stops placing here without waiting for the poller), the
+        # consecutive-failed-poll counter behind flap damping, and the
+        # drain latch a scale-down/role-flip sets while it empties.
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self.fail_polls = 0
+        self.removing = False
 
     def fetch_health(self, timeout: float = 2.0) -> dict:
         """The replica's ``/healthz`` payload — over HTTP when the
@@ -126,7 +143,21 @@ class Replica:
         return self.server.health()
 
     def placeable(self) -> bool:
-        return self.healthy
+        """In the placement pool at all: alive, not draining for a
+        scale-down/role-flip, and the breaker is not OPEN.  The
+        half-open single-probe admission is enforced separately
+        (``try_place`` consumes the probe slot)."""
+        from ml_trainer_tpu.serving import overload
+
+        return (
+            self.healthy and not self.removing
+            and self.breaker.state != overload.OPEN
+        )
+
+    def try_place(self) -> bool:
+        """May a request land here RIGHT NOW — placeable, and if the
+        breaker is half-open, this caller won the single probe slot."""
+        return self.placeable() and self.breaker.allow()
 
     def load_score(self) -> tuple:
         """Least-loaded ordering key from the last health payload:
@@ -181,6 +212,15 @@ class RouterMetrics:
         self.redistributes_total = 0
         self.errors_total = 0
         self.replica_healthy: Dict[str, int] = {}
+        # Overload/failure hardening counters (serving/overload.py,
+        # docs/serving.md "Surviving overload"): hedged prefills fired
+        # and won, CRC-rejected migration payloads, requests the ladder
+        # shed at the router, and damped (absorbed) health-poll flaps.
+        self.hedges_total = 0
+        self.hedge_wins_total = 0
+        self.migrations_corrupt_total = 0
+        self.shed_total = 0
+        self.flaps_damped_total = 0
 
     def record_request(self, replica: str, role: str) -> None:
         with self._lock:
@@ -195,6 +235,26 @@ class RouterMetrics:
     def record_redistribute(self) -> None:
         with self._lock:
             self.redistributes_total += 1
+
+    def record_hedge(self) -> None:
+        with self._lock:
+            self.hedges_total += 1
+
+    def record_hedge_win(self) -> None:
+        with self._lock:
+            self.hedge_wins_total += 1
+
+    def record_corrupt_migration(self) -> None:
+        with self._lock:
+            self.migrations_corrupt_total += 1
+
+    def record_shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
+    def record_flap_damped(self) -> None:
+        with self._lock:
+            self.flaps_damped_total += 1
 
     def record_error(self) -> None:
         with self._lock:
@@ -214,6 +274,11 @@ class RouterMetrics:
                 "migrations_total": self.migrations_total,
                 "kv_migrated_bytes_total": self.kv_migrated_bytes_total,
                 "redistributes_total": self.redistributes_total,
+                "hedges_total": self.hedges_total,
+                "hedge_wins_total": self.hedge_wins_total,
+                "migrations_corrupt_total": self.migrations_corrupt_total,
+                "shed_total": self.shed_total,
+                "flaps_damped_total": self.flaps_damped_total,
                 "errors_total": self.errors_total,
                 "replica_healthy": dict(sorted(
                     self.replica_healthy.items()
@@ -235,39 +300,53 @@ class Router:
                  max_inflight: Optional[int] = None,
                  slo: Optional[SloPolicy] = None,
                  slo_timelines: int = 256,
-                 own_servers: bool = False):
+                 own_servers: bool = False,
+                 unhealthy_after: int = 2,
+                 breaker_threshold: Optional[int] = 3,
+                 breaker_cooldown_s: float = 2.0,
+                 hedging: bool = True,
+                 hedge_quantile: float = 0.99,
+                 hedge_factor: float = 1.5,
+                 hedge_min_s: float = 0.05,
+                 degradation: Optional[DegradationConfig] = None):
+        """Hardening knobs (docs/serving.md "Surviving overload"):
+
+        ``unhealthy_after``: consecutive FAILED health polls before a
+        replica is marked unhealthy (flap damping — one transient
+        timeout must not trigger a spurious drain-and-redistribute).
+        ``breaker_threshold``/``breaker_cooldown_s``: per-replica
+        circuit breakers — K consecutive placement failures open the
+        breaker without waiting for the poller; after the cooldown one
+        half-open probe decides.  ``breaker_threshold=None`` disables
+        breakers (chaos baselines).  ``hedging``: fire a duplicate
+        prefill on another replica once a request has waited past
+        ``hedge_factor`` x the rolling ``hedge_quantile`` first-result
+        latency (floored at ``hedge_min_s``); first winner cancels the
+        loser.  Only deterministic requests hedge (greedy, or sampled
+        with an explicit seed — the duplicate then computes identical
+        bytes, so the race cannot change the output).  ``degradation``
+        configures the router's :class:`DegradationLadder`
+        (``router.ladder``) applied fleet-wide."""
         if not replicas:
             raise ValueError("router needs at least one replica")
+        if unhealthy_after < 1:
+            raise ValueError(
+                f"unhealthy_after must be >= 1, got {unhealthy_after}"
+            )
         urls = replica_urls or {}
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
         self._replicas: Dict[str, Replica] = {
-            name: Replica(name, srv, urls.get(name))
+            name: Replica(name, srv, urls.get(name),
+                          breaker=self._new_breaker())
             for name, srv in sorted(replicas.items())
         }
         roles = {r.role for r in self._replicas.values()}
         self.mode = "colocated" if roles == {"both"} else "disagg"
         engines = [r.server.engine for r in self._replicas.values()]
         e0 = engines[0]
-        for e in engines[1:]:
-            if (e.max_len != e0.max_len
-                    or e.vocab_size != e0.vocab_size):
-                raise ValueError(
-                    "replicas must share model geometry: got max_len "
-                    f"{e.max_len} vs {e0.max_len}, vocab {e.vocab_size} "
-                    f"vs {e0.vocab_size}"
-                )
-        if self.mode == "disagg":
-            for name, rep in self._replicas.items():
-                e = rep.server.engine
-                if not e.paged:
-                    raise ValueError(
-                        f"disaggregated mode needs paged engines "
-                        f"(kv_page_size > 0): replica '{name}' is "
-                        "contiguous — pages are the migration unit"
-                    )
-                if e.kv_page_size != engines[0].kv_page_size:
-                    raise ValueError(
-                        "replicas must share kv_page_size for migration"
-                    )
+        for name, rep in self._replicas.items():
+            self._validate_geometry(name, rep.server)
         self.max_len = e0.max_len
         self.vocab_size = e0.vocab_size
         self._spec_slack = max(e.spec_k for e in engines)
@@ -294,11 +373,27 @@ class Router:
         self._stop_event = threading.Event()
         self._httpd = None
         self._http_thread = None
-        prefill_names = [
-            n for n, r in self._replicas.items()
-            if r.role in ("prefill", "both")
-        ] or list(self._replicas)
-        self._ring = _HashRing(prefill_names)
+        self.unhealthy_after = int(unhealthy_after)
+        self.hedging = bool(hedging)
+        self.hedge_quantile = float(hedge_quantile)
+        self.hedge_factor = float(hedge_factor)
+        self.hedge_min_s = float(hedge_min_s)
+        # Rolling first-result latency (submit-attempt -> first token
+        # or migration): the hedging clock.  Under overload the window
+        # inflates with the queues, so hedges back off exactly when
+        # duplicates would hurt most.
+        self._first_result_lat = RollingQuantile(
+            window=256, min_samples=8, default=1.0
+        )
+        # Fleet-wide degradation ladder: rungs apply to every replica
+        # (current AND later-added) via Server.set_degradation.
+        self.ladder = DegradationLadder(
+            lambda: [r.server for r in self._replicas.values()],
+            config=degradation, name="router",
+        )
+        self._reindex_replicas()
+        self._rebuild_ring()
+        self._busy_polls = 0
         for rep in self._replicas.values():
             rep.last_health = rep.fetch_health()
             self.metrics.set_replica_health(rep.name, True)
@@ -307,6 +402,52 @@ class Router:
             target=self._poll_health, daemon=True, name="router-health"
         )
         self._poller.start()
+
+    def _new_breaker(self) -> CircuitBreaker:
+        """A breaker per the router's config; threshold None = breakers
+        disabled (a breaker that never opens)."""
+        if self.breaker_threshold is None:
+            return CircuitBreaker(threshold=10 ** 9, cooldown_s=1.0)
+        return CircuitBreaker(
+            threshold=self.breaker_threshold,
+            cooldown_s=self.breaker_cooldown_s,
+        )
+
+    def _validate_geometry(self, name: str, server: Server) -> None:
+        """One replica's engine against the fleet's reference geometry
+        (the first replica's) — shared by __init__ and add_replica."""
+        engines = [r.server.engine for r in self._replicas.values()]
+        e0, e = engines[0], server.engine
+        if e.max_len != e0.max_len or e.vocab_size != e0.vocab_size:
+            raise ValueError(
+                "replicas must share model geometry: got max_len "
+                f"{e.max_len} vs {e0.max_len}, vocab {e.vocab_size} "
+                f"vs {e0.vocab_size}"
+            )
+        if self.mode == "disagg":
+            if not e.paged:
+                raise ValueError(
+                    f"disaggregated mode needs paged engines "
+                    f"(kv_page_size > 0): replica '{name}' is "
+                    "contiguous — pages are the migration unit"
+                )
+            if e.kv_page_size != e0.kv_page_size:
+                raise ValueError(
+                    "replicas must share kv_page_size for migration"
+                )
+
+    def _reindex_replicas(self) -> None:
+        """Stable fleet indices (sorted-name order) — what the chaos
+        faults' ``host=`` parameter names."""
+        for i, name in enumerate(sorted(self._replicas)):
+            self._replicas[name].server.replica_index = i
+
+    def _rebuild_ring(self) -> None:
+        prefill_names = [
+            n for n, r in self._replicas.items()
+            if r.role in ("prefill", "both")
+        ] or list(self._replicas)
+        self._ring = _HashRing(prefill_names)
 
     # -- construction -----------------------------------------------------
 
@@ -416,6 +557,174 @@ class Router:
         self.metrics.set_replica_health(name, False)
         rep.server._mark_unhealthy(f"replica '{name}' killed")
 
+    # -- fleet management (serving/autoscaler.py) -------------------------
+
+    def add_replica(self, name: str, server: Server,
+                    url: Optional[str] = None) -> None:
+        """Grow the fleet by one replica (thread-safe; the autoscaler's
+        scale-up action).  The new replica inherits the fleet's current
+        degradation rung, joins the affinity ring/placement pools, and
+        shares the process compile cache — adding capacity under load
+        mints no compiles when the geometry matches (enforced)."""
+        if name in self._replicas:
+            raise ValueError(f"replica '{name}' already exists")
+        if server.role not in ("prefill", "decode", "both"):
+            raise ValueError(f"bad role {server.role!r}")
+        if self.mode == "colocated" and server.role != "both":
+            raise ValueError(
+                "a colocated fleet only takes role='both' replicas"
+            )
+        self._validate_geometry(name, server)
+        rep = Replica(name, server, url, breaker=self._new_breaker())
+        server.set_degradation(self.ladder.level, self.ladder.config)
+        rep.last_health = rep.fetch_health()
+        with self._lock:
+            self._replicas = {
+                **self._replicas, name: rep,
+            }
+        self._reindex_replicas()
+        self._rebuild_ring()
+        self.metrics.set_replica_health(name, True)
+        from ml_trainer_tpu.telemetry.flight import get_recorder
+
+        get_recorder().record(
+            "fleet_change", action="add_replica", replica=name,
+            role=server.role, fleet=len(self._replicas),
+        )
+        self._log.info(
+            "router_replica_added", replica=name, role=server.role
+        )
+
+    def remove_replica(self, name: str, timeout: float = 30.0,
+                       close: Optional[bool] = None) -> bool:
+        """Shrink the fleet by one replica (the autoscaler's scale-down
+        action): stop placing work on it, wait for it to drain
+        naturally (bounded by ``timeout``), then detach it (closing its
+        server when the router owns the fleet, or when ``close=True``).
+        Returns True when the replica drained clean; a False return
+        means in-flight work was failed-and-redistributed at detach —
+        clients still finish via the redistribute path."""
+        rep = self._replicas[name]
+        rep.removing = True  # leaves every placement pool immediately
+        deadline = time.monotonic() + timeout
+        drained = False
+        while time.monotonic() < deadline and not self._stopping:
+            h = rep.server.health() if not rep.url else rep.fetch_health()
+            if (
+                not h.get("active_slots")
+                and not h.get("queue_depth")
+                and not h.get("adoptions_pending")
+            ):
+                drained = True
+                break
+            self._stop_event.wait(0.05)
+        with self._lock:
+            reps = dict(self._replicas)
+            reps.pop(name, None)
+            self._replicas = reps
+            self._sessions = {
+                s: n for s, n in self._sessions.items() if n != name
+            }
+        self._reindex_replicas()
+        self._rebuild_ring()
+        if not drained:
+            # Detaching with work in flight: fail it structured so the
+            # pumps redistribute — never strand a stream.
+            rep.server._mark_unhealthy(
+                f"replica '{name}' removed by the autoscaler"
+            )
+        if close if close is not None else self._own_servers:
+            rep.server.close()
+        self.metrics.set_replica_health(name, False)
+        from ml_trainer_tpu.telemetry.flight import get_recorder
+
+        get_recorder().record(
+            "fleet_change", action="remove_replica", replica=name,
+            drained=drained, fleet=len(self._replicas),
+        )
+        self._log.info(
+            "router_replica_removed", replica=name, drained=drained
+        )
+        return drained
+
+    def reassign_role(self, name: str, role: str,
+                      timeout: float = 30.0) -> bool:
+        """Flip a replica's role prefill<->decode (the autoscaler's
+        rebalance action) by DRAINING it through the PR 13 migration
+        machinery first: the replica leaves the placement pools, its
+        active slots' KV is exported page-granular and adopted onto
+        other decode replicas (streams keep flowing — no re-prefill),
+        its queued requests redistribute, and only then does the role
+        flip and the affinity ring rebuild.  Returns True on success;
+        False when the drain timed out (role unchanged, replica back in
+        its old pools — a flip must never half-happen)."""
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"role must be 'prefill' or 'decode', got {role!r}"
+            )
+        if self.mode != "disagg":
+            raise ValueError("role reassignment needs a disagg fleet")
+        rep = self._replicas[name]
+        if rep.role == role:
+            return True
+        rep.removing = True
+        evacuated = rep.server.evacuate(
+            lambda req, export: self._adopt_evacuated(req, export, rep),
+            timeout=timeout,
+        )
+        if not evacuated:
+            rep.removing = False
+            self._log.error(
+                "router_role_flip_timeout", replica=name, role=role
+            )
+            return False
+        rep.role = role
+        rep.server.role = role
+        rep.removing = False
+        with self._lock:
+            self._sessions = {
+                s: n for s, n in self._sessions.items() if n != name
+            }
+        self._rebuild_ring()
+        from ml_trainer_tpu.telemetry.flight import get_recorder
+
+        get_recorder().record(
+            "fleet_change", action="reassign_role", replica=name,
+            role=role,
+        )
+        self._log.info(
+            "router_role_reassigned", replica=name, role=role
+        )
+        return True
+
+    def _adopt_evacuated(self, req: Request, export, source: Replica
+                         ) -> None:
+        """Adoption sink for a role-flip evacuation: land the exported
+        slot on any other decode candidate (CRC-verified, fresh
+        serialization per candidate).  When nobody can take it, the
+        request fails with a retryable ``draining`` error and its pump
+        redistributes — byte-identical either way."""
+        for rep in self._decode_candidates():
+            if rep is source or not rep.try_place():
+                continue
+            payload = transfer.to_bytes(export)
+            try:
+                incoming = transfer.from_bytes(payload)
+                rep.server.adopt(req, incoming)
+            except MigrationCorrupt:
+                self.metrics.record_corrupt_migration()
+                continue
+            except (AdmissionError, EngineUnhealthy, RuntimeError):
+                continue
+            self.metrics.record_migration(len(payload))
+            req.mark("evac_adopted", to=rep.name)
+            return
+        req.finish(
+            "error",
+            "replica draining for role reassignment: no candidate "
+            "could adopt the evacuated KV; request redistributed",
+        )
+
     def health(self) -> dict:
         """The router ``/healthz`` payload: aggregate liveness plus
         every replica's last health snapshot."""
@@ -423,6 +732,7 @@ class Router:
             name: {
                 "healthy": rep.healthy,
                 "role": rep.role,
+                "breaker": rep.breaker.state,
                 **{
                     k: rep.last_health.get(k)
                     for k in ("active_slots", "queue_depth",
@@ -441,6 +751,7 @@ class Router:
             "replicas_total": len(self._replicas),
             "inflight": inflight,
             "sessions": len(self._sessions),
+            "degradation_level": self.ladder.level,
             "replicas": reps,
         }
 
@@ -449,6 +760,7 @@ class Router:
         artifact's router section)."""
         snap = self.metrics.snapshot()
         snap["mode"] = self.mode
+        snap["degradation"] = self.ladder.snapshot()
         with self._lock:
             snap["inflight"] = self._inflight
             snap["sessions"] = len(self._sessions)
@@ -486,17 +798,35 @@ class Router:
         ).tobytes()
         return tenant.encode() + b"|" + block
 
-    def _place(self, creq: Request,
-               session: Optional[str]) -> Tuple[Replica, Replica]:
+    def _place(self, creq: Request, session: Optional[str],
+               exclude_prefill: Optional[str] = None
+               ) -> Tuple[Replica, Replica]:
         """(prefill replica, decode replica) for this attempt, from live
-        health.  Raises ``EngineUnhealthy`` when nothing is placeable."""
+        health, breaker-gated.  ``exclude_prefill`` skips the named
+        replica (the hedging path never duplicates onto the replica it
+        is hedging against).  Raises ``EngineUnhealthy`` when nothing
+        is placeable."""
         alive = self._alive()
         if not alive:
             raise EngineUnhealthy("no healthy replica available")
         key = self._affinity_key(creq.tenant, creq.prompt)
         if self.mode == "colocated":
-            name = self._ring.place(key, alive) or sorted(alive)[0]
-            rep = alive[name]
+            pool = {
+                n: r for n, r in alive.items() if n != exclude_prefill
+            } or alive
+            name = self._ring.place(key, pool) or sorted(pool)[0]
+            rep = pool[name]
+            if not rep.breaker.allow():
+                others = sorted(
+                    (r for r in pool.values()
+                     if r is not rep and r.breaker.allow()),
+                    key=Replica.load_score,
+                )
+                if not others:
+                    raise EngineUnhealthy(
+                        "no placeable replica: breakers open/probing"
+                    )
+                rep = others[0]
             return rep, rep
         prefill_pool = {
             n: r for n, r in alive.items()
@@ -506,13 +836,29 @@ class Router:
             n: r for n, r in alive.items()
             if r.role in ("decode", "both")
         } or alive
+        if exclude_prefill and len(prefill_pool) > 1:
+            prefill_pool = {
+                n: r for n, r in prefill_pool.items()
+                if n != exclude_prefill
+            }
         name = self._ring.place(key, prefill_pool) or sorted(prefill_pool)[0]
         prefill = prefill_pool[name]
+        if not prefill.breaker.allow():
+            others = sorted(
+                (r for r in prefill_pool.values()
+                 if r is not prefill and r.breaker.allow()),
+                key=Replica.load_score,
+            )
+            if not others:
+                raise EngineUnhealthy(
+                    "no placeable prefill replica: breakers open/probing"
+                )
+            prefill = others[0]
         decode = None
         if session:
             with self._lock:
                 sticky = self._sessions.get(session)
-            if sticky in decode_pool:
+            if sticky in decode_pool and decode_pool[sticky].placeable():
                 decode = decode_pool[sticky]
         if decode is None:
             decode = min(decode_pool.values(), key=Replica.load_score)
@@ -575,7 +921,9 @@ class Router:
             if deadline is not None and deadline <= 0:
                 creq.finish(
                     "expired",
-                    f"deadline ({creq.deadline}s) passed while routing",
+                    f"deadline ({creq.deadline}s) passed while routing "
+                    f"({redistributes} redistribution(s) consumed the "
+                    "budget)",
                 )
                 return
             # Resume from what the CLIENT received, not what the shadow
@@ -587,18 +935,32 @@ class Router:
             placed = self._submit_attempt(creq, shadow, session)
             if placed is None:
                 return  # _submit_attempt finished creq with the reason
-            decode_rep = placed
-            outcome = self._pump(creq, shadow, decode_rep)
+            prefill_rep, decode_rep = placed
+            outcome, shadow, decode_rep = self._pump(
+                creq, shadow, decode_rep, prefill_rep, session
+            )
             if outcome == "done":
                 creq.preemptions = shadow.preemptions
+                decode_rep.breaker.record_success()
                 creq.finish("done")
                 return
             if outcome == "expired":
                 creq.finish("expired", shadow.error)
                 return
+            if outcome == "shed":
+                # A replica-side degradation rung shed the shadow: the
+                # structured refusal propagates to the client verbatim
+                # (503 + retry_after on the HTTP path).
+                self.metrics.record_shed()
+                creq.retry_after = shadow.retry_after
+                creq.finish("shed", shadow.error)
+                return
             if outcome == "retry":
                 redistributes += 1
                 self.metrics.record_redistribute()
+                decode_rep.breaker.record_failure(
+                    shadow.error or "stream failed"
+                )
                 creq.preemptions = shadow.preemptions + 1
                 creq.mark(
                     "redistributed", attempt=redistributes,
@@ -620,18 +982,33 @@ class Router:
             return
 
     def _submit_attempt(self, creq: Request, shadow: Request,
-                        session: Optional[str]) -> Optional[Replica]:
-        """Place + submit one attempt.  Returns the decode replica on
-        success, or None after finishing ``creq`` with a structured
-        error (placement/admission exhausted)."""
+                        session: Optional[str],
+                        exclude_prefill: Optional[str] = None,
+                        quiet: bool = False
+                        ) -> Optional[Tuple[Replica, Replica]]:
+        """Place + submit one attempt.  Returns ``(prefill, decode)``
+        replicas on success, or None after finishing ``creq`` with a
+        structured error (placement/admission exhausted — unless
+        ``quiet``, the hedging path, where failure just means no
+        duplicate fires).  The retry window is capped by the request's
+        remaining deadline: a 1-second-deadline request never spins the
+        full admission retry budget."""
         give_up_at = time.monotonic() + self.admission_retry_s
+        deadline_at = (
+            creq.submitted_at + creq.deadline
+            if creq.deadline is not None else None
+        )
+        if deadline_at is not None:
+            give_up_at = min(give_up_at, deadline_at)
         last_err = "no healthy replica available"
         while not self._stopping:
             try:
-                prefill_rep, decode_rep = self._place(creq, session)
+                prefill_rep, decode_rep = self._place(
+                    creq, session, exclude_prefill=exclude_prefill
+                )
             except EngineUnhealthy as e:
                 last_err = str(e)
-                if time.monotonic() > give_up_at:
+                if time.monotonic() > give_up_at or quiet:
                     break
                 self._stop_event.wait(0.05)
                 continue
@@ -642,28 +1019,51 @@ class Router:
             )
             try:
                 prefill_rep.server.submit_request(shadow)
+            except OverloadShed as e:
+                # The replica's degradation ladder refused it — a
+                # structured terminal, not a placement failure.
+                if quiet:
+                    return None
+                creq.retry_after = e.retry_after
+                self.metrics.record_shed()
+                creq.finish("shed", str(e))
+                return None
             except AdmissionError as e:
                 last_err = str(e)
-                if time.monotonic() > give_up_at:
+                prefill_rep.breaker.record_success()  # alive, just full
+                if time.monotonic() > give_up_at or quiet:
                     break
                 self._stop_event.wait(0.02)
                 continue
             except (EngineUnhealthy, RuntimeError) as e:
                 # The poller will confirm, but don't wait for it.
                 last_err = str(e)
+                prefill_rep.breaker.record_failure(str(e))
                 prefill_rep.healthy = False
                 self.metrics.set_replica_health(prefill_rep.name, False)
-                if time.monotonic() > give_up_at:
+                if time.monotonic() > give_up_at or quiet:
                     break
                 continue
             creq.mark(
                 "routed", prefill=prefill_rep.name,
                 decode=decode_rep.name, disagg=disagg,
+                hedge=bool(exclude_prefill),
             )
             self.metrics.record_request(
                 prefill_rep.name, "prefill" if disagg else "colocated"
             )
-            return decode_rep
+            return prefill_rep, decode_rep
+        if quiet:
+            return None
+        if (
+            deadline_at is not None and time.monotonic() >= deadline_at
+        ):
+            creq.finish(
+                "expired",
+                f"deadline ({creq.deadline}s) passed while placing "
+                f"request {creq.id}: {last_err}",
+            )
+            return None
         self.metrics.record_error()
         creq.finish(
             "error",
@@ -672,53 +1072,214 @@ class Router:
         )
         return None
 
-    def _pump(self, creq: Request, shadow: Request,
-              decode_rep: Replica) -> str:
+    def _hedge_after_s(self) -> float:
+        """Seconds a request may wait for its first result before the
+        router fires a duplicate prefill: ``hedge_factor`` x the
+        rolling ``hedge_quantile`` first-result latency, floored."""
+        return max(
+            self.hedge_min_s,
+            self.hedge_factor
+            * self._first_result_lat.quantile(self.hedge_quantile),
+        )
+
+    def _hedge_eligible(self, creq: Request) -> bool:
+        """Hedging duplicates work — it must never change bytes.  A
+        greedy request is deterministic; a sampled request is only
+        hedgeable when the caller pinned the seed (both replicas then
+        compute the identical stream, so the race winner is
+        irrelevant)."""
+        return self.hedging and (
+            creq.temperature == 0.0 or creq.rng is not None
+        )
+
+    def _pump(self, creq: Request, shadow: Request, decode_rep: Replica,
+              prefill_rep: Replica, session: Optional[str]
+              ) -> tuple:
         """Forward the shadow's stream to the client, adopting the KV
-        export into the decode replica when it arrives.  Returns
-        ``done`` / ``expired`` / ``retry`` (replica failure —
+        export into the decode replica when it arrives, HEDGING the
+        attempt onto another prefill replica when the first result is
+        late.  Returns ``(outcome, winning_shadow)`` — outcome is
+        ``done`` / ``expired`` / ``shed`` / ``retry`` (replica failure,
         redistribute) / ``error`` (structured terminal)."""
+        t0 = time.monotonic()
+        first_seen = False
+        hedge_shadow: Optional[Request] = None
+        hedge_pair: Optional[Tuple[Replica, Replica]] = None
+        hedge_at = (
+            t0 + self._hedge_after_s()
+            if self._hedge_eligible(creq) else None
+        )
         while True:
+            # Before the first result arrives, poll at a cadence that
+            # can notice the hedge deadline; afterwards the plain 0.5s
+            # drain is enough.
+            wait = 0.5
+            if not first_seen and hedge_at is not None:
+                wait = min(wait, max(hedge_at - time.monotonic(), 0.01))
             try:
-                item = shadow._stream.get(timeout=0.5)
+                item = shadow._stream.get(timeout=wait)
             except _queue.Empty:
                 if self._stopping:
                     shadow.error = shadow.error or "router is closed"
-                    return "error"
-                continue
+                    return "error", shadow, decode_rep
+                if (
+                    not first_seen and hedge_at is not None
+                    and hedge_shadow is None
+                    and time.monotonic() >= hedge_at
+                ):
+                    hedge_shadow, hedge_pair = self._fire_hedge(
+                        creq, prefill_rep, session
+                    )
+                    if hedge_shadow is None:
+                        # No idle capacity to duplicate onto right now;
+                        # re-check at a gentle cadence — a slot may free
+                        # up while this request is still stuck.
+                        hedge_at = time.monotonic() + 0.25
+                if hedge_shadow is not None and not first_seen:
+                    # Race: whichever stream produces first wins.
+                    try:
+                        h_item = hedge_shadow._stream.get(timeout=0.02)
+                    except _queue.Empty:
+                        continue
+                    # The hedge won: cancel the primary, swap streams.
+                    self.metrics.record_hedge_win()
+                    creq.mark(
+                        "hedge_won", prefill=hedge_pair[0].name,
+                        decode=hedge_pair[1].name,
+                    )
+                    self._cancel_attempt(prefill_rep, shadow)
+                    shadow, hedge_shadow = hedge_shadow, None
+                    prefill_rep, decode_rep = hedge_pair
+                    item = h_item
+                else:
+                    continue
+            if not first_seen:
+                first_seen = True
+                if hedge_at is None or time.monotonic() < hedge_at:
+                    # Only un-hedged first results feed the hedge
+                    # clock: a rescued attempt's (slow) latency would
+                    # otherwise inflate the p99 and talk later hedges
+                    # out of firing exactly while a replica is sick.
+                    self._first_result_lat.observe(time.monotonic() - t0)
+                if hedge_shadow is not None:
+                    # The primary won the race: withdraw the duplicate.
+                    self._cancel_attempt(hedge_pair[0], hedge_shadow)
+                    hedge_shadow = None
             if item == _DONE:
                 if shadow.state == "done":
-                    return "done"
+                    return "done", shadow, decode_rep
                 if shadow.state == "expired":
-                    return "expired"
+                    return "expired", shadow, decode_rep
+                if shadow.state == "shed":
+                    return "shed", shadow, decode_rep
                 if self._stopping or not self._retryable(shadow.error):
-                    return "error"
-                return "retry"
+                    return "error", shadow, decode_rep
+                return "retry", shadow, decode_rep
             if isinstance(item, tuple) and item[0] == _MIGRATE:
                 if not self._adopt(creq, shadow, decode_rep, item[1]):
-                    return "retry"
+                    return "retry", shadow, decode_rep
                 continue
             creq.push_token(int(item))
+
+    def _fire_hedge(self, creq: Request, primary_prefill: Replica,
+                    session: Optional[str]):
+        """Fire the duplicate prefill on a DIFFERENT prefill replica
+        (quiet placement — no duplicate available just means no hedge).
+        Returns ``(hedge_shadow, (prefill, decode))`` or ``(None,
+        None)``.
+
+        Hedges only target genuinely IDLE capacity: when every other
+        replica is also loaded (uniform saturation), a duplicate just
+        queues behind existing work and doubles the fleet's prefill
+        load exactly when it can least afford it — the classic hedging
+        anti-pattern.  The depth gate makes hedging self-throttling:
+        it rescues requests stuck behind a sick replica while healthy
+        capacity idles, and stands down when the whole fleet is the
+        bottleneck (the degradation ladder's job, not hedging's)."""
+        alive = self._alive()
+        pool = [
+            r for r in alive.values()
+            if r.role in ("prefill", "both") and r is not primary_prefill
+        ]
+        if not pool:
+            return None, None
+        best = min(pool, key=Replica.load_score)
+        if best.load_score()[0] >= best.server.engine.max_batch:
+            return None, None
+        hedge_shadow = self._shadow(
+            creq, list(creq.tokens), self._remaining_deadline(creq)
+        )
+        placed = self._submit_attempt(
+            creq, hedge_shadow, session,
+            exclude_prefill=primary_prefill.name, quiet=True,
+        )
+        if placed is None:
+            return None, None
+        if placed[0] is primary_prefill:
+            # Only one prefill replica is placeable: a duplicate on the
+            # same replica would just deepen its queue.
+            self._cancel_attempt(placed[0], hedge_shadow)
+            return None, None
+        self.metrics.record_hedge()
+        creq.mark(
+            "hedged", prefill=placed[0].name, decode=placed[1].name,
+            after_ms=round(self._hedge_after_s() * 1e3, 1),
+        )
+        return hedge_shadow, placed
+
+    def _cancel_attempt(self, rep: Replica, shadow: Request) -> None:
+        """Withdraw a raced attempt's losing shadow from its replica
+        (best effort — the replica may already be failing it)."""
+        try:
+            rep.server.cancel(shadow)
+        except Exception:  # noqa: BLE001 — the loser is abandoned anyway
+            pass
 
     def _adopt(self, creq: Request, shadow: Request,
                decode_rep: Replica, export) -> bool:
         """Hand the exported KV to a decode replica — the placed one
-        first, any healthy decode candidate as fallback.  The payload
-        round-trips through the serialized form so the migration is
-        transport-shaped and metered in real bytes."""
-        payload = transfer.to_bytes(export)
-        export = transfer.from_bytes(payload)
+        first, any healthy decode candidate as fallback.  Every
+        candidate gets a FRESH serialization round-trip (the payload is
+        transport-shaped and metered in real bytes), CRC32-verified on
+        deserialization AND import: a corrupt payload (chaos
+        ``migration_corrupt``, or a real transport flip) is refused
+        with a structured error and the adoption retries on the next
+        candidate instead of silently adopting garbage."""
+        from ml_trainer_tpu.resilience.faults import active_plan
+
         candidates = [decode_rep] + [
             r for r in self._decode_candidates() if r is not decode_rep
         ]
         for rep in candidates:
-            if not rep.placeable():
+            if not rep.try_place():
                 continue
+            payload = transfer.to_bytes(export)
+            plan = active_plan()
+            if plan is not None:
+                fault = plan.fire("migration_corrupt")
+                if fault is not None:
+                    # One bit flipped in flight: the CRC gate below
+                    # must catch it.
+                    flipped = bytearray(payload)
+                    flipped[len(flipped) // 2] ^= 0x40
+                    payload = bytes(flipped)
             try:
-                rep.server.adopt(shadow, export)
+                incoming = transfer.from_bytes(payload)
+                rep.server.adopt(shadow, incoming)
+            except MigrationCorrupt as e:
+                self.metrics.record_corrupt_migration()
+                self._log.error(
+                    "router_migration_corrupt", replica=rep.name,
+                    error=str(e),
+                )
+                creq.mark(
+                    "migration_corrupt", to=rep.name, error=str(e),
+                )
+                continue  # fresh serialization for the next candidate
             except AdmissionError:
                 continue
-            except (EngineUnhealthy, RuntimeError):
+            except (EngineUnhealthy, RuntimeError) as e:
+                rep.breaker.record_failure(str(e))
                 rep.healthy = False
                 self.metrics.set_replica_health(rep.name, False)
                 continue
@@ -747,13 +1308,14 @@ class Router:
         return any(
             needle in err
             for needle in ("unhealthy", "server closed", "wedged",
-                           "engine thread died", "killed")
+                           "engine thread died", "killed", "draining")
         )
 
     # -- health polling ---------------------------------------------------
 
     def _poll_health(self) -> None:
         while not self._stopping:
+            self._fire_chaos_kill()
             for rep in self._replicas.values():
                 payload = rep.fetch_health()
                 rep.last_health = payload
@@ -763,6 +1325,28 @@ class Router:
                     and not payload.get("draining")
                     and not payload.get("closed")
                 )
+                if ok:
+                    rep.fail_polls = 0
+                    if not rep.healthy:
+                        # Recovered (or the flap cleared): rejoin the
+                        # placement pool.
+                        self._log.info(
+                            "router_replica_recovered", replica=rep.name
+                        )
+                else:
+                    rep.fail_polls += 1
+                    if rep.fail_polls < self.unhealthy_after and rep.healthy:
+                        # Flap damping: ONE dropped/failed poll is a
+                        # transient until K consecutive confirm it —
+                        # a spurious drain-and-redistribute costs far
+                        # more than one poll interval of patience.
+                        self.metrics.record_flap_damped()
+                        self._log.info(
+                            "router_healthz_flap_damped", replica=rep.name,
+                            fail_polls=rep.fail_polls,
+                            reason=payload.get("reason"),
+                        )
+                        continue
                 if rep.healthy and not ok:
                     self._log.error(
                         "router_replica_unhealthy", replica=rep.name,
@@ -771,6 +1355,33 @@ class Router:
                 rep.healthy = ok
                 self.metrics.set_replica_health(rep.name, ok)
             self._stop_event.wait(self._health_interval)
+
+    def _fire_chaos_kill(self) -> None:
+        """``replica_kill`` chaos hook (resilience/faults.py): at the
+        matching BUSY poll (the fleet is serving traffic), kill the
+        replica whose fleet index matches the fault's ``host`` — the
+        real watchdog-death path, under real load."""
+        from ml_trainer_tpu.resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is None:
+            return
+        with self._lock:
+            busy = self._inflight > 0
+        if not busy:
+            return
+        self._busy_polls += 1
+        fault = plan.fire("replica_kill", step=self._busy_polls)
+        if fault is None:
+            return
+        for name, rep in sorted(self._replicas.items()):
+            if rep.server.replica_index == fault.host and rep.healthy:
+                self._log.error(
+                    "router_chaos_replica_kill", replica=name,
+                    poll=self._busy_polls,
+                )
+                self.kill_replica(name)
+                return
 
     # -- telemetry --------------------------------------------------------
 
@@ -806,6 +1417,34 @@ class Router:
             "router_redistributes_total",
             "in-flight requests redistributed off a failed replica",
         ).set(float(snap["redistributes_total"]))
+        r.gauge(
+            "router_hedges_total",
+            "duplicate prefills fired after the rolling-p99 hedge clock",
+        ).set(float(snap["hedges_total"]))
+        r.gauge(
+            "router_hedge_wins_total",
+            "hedged duplicates that beat the primary attempt",
+        ).set(float(snap["hedge_wins_total"]))
+        r.gauge(
+            "router_migrations_corrupt_total",
+            "KV migration payloads refused by the CRC32 verify",
+        ).set(float(snap["migrations_corrupt_total"]))
+        r.gauge(
+            "router_shed_total",
+            "requests shed by the degradation ladder at the router",
+        ).set(float(snap["shed_total"]))
+        r.gauge(
+            "router_flaps_damped_total",
+            "failed health polls absorbed by flap damping",
+        ).set(float(snap["flaps_damped_total"]))
+        breaker = r.gauge(
+            "router_breaker_state",
+            "per-replica circuit breaker (0 closed, 1 half-open, 2 open)",
+            labelnames=("replica",),
+        )
+        for name, rep in self._replicas.items():
+            breaker.labels(replica=name).set(float(rep.breaker.gauge_value()))
+        self.ladder.publish(r)
         healthy = r.gauge(
             "router_replica_healthy",
             "1 while the replica is placeable, 0 once it left the pool",
@@ -844,11 +1483,17 @@ class Router:
             def log_message(self, *args):  # quiet: we have metrics
                 pass
 
-            def _send(self, code: int, payload: dict):
+            def _send(self, code: int, payload: dict,
+                      retry_after: Optional[float] = None):
                 body = json.dumps(payload).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(round(retry_after)))),
+                    )
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -887,18 +1532,33 @@ class Router:
                     n = int(self.headers.get("Content-Length", 0))
                     body = json.loads(self.rfile.read(n) or b"{}")
                     session = body.get("session")
+                    deadline = body.get("deadline")
                     out = router.complete(
                         np.asarray(body["prompt"], np.int32),
                         int(body.get("max_new_tokens", 16)),
                         temperature=float(body.get("temperature", 0.0)),
                         rng=body.get("seed"),
                         eos_token_id=body.get("eos_token_id"),
-                        deadline=body.get("deadline"),
+                        deadline=deadline,
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)),
                         session=str(session) if session else None,
+                        # The HTTP wait is capped by the client's own
+                        # deadline (plus routing slack): a deadline'd
+                        # request gets a timely 504, and the remaining
+                        # budget decrements across every redistribute
+                        # and hedge inside the router.
+                        timeout=(
+                            float(deadline) + 30.0
+                            if deadline is not None else None
+                        ),
                     )
                     self._send(200, {"tokens": [int(t) for t in out]})
+                except OverloadShed as e:
+                    payload = {"error": str(e)}
+                    if e.retry_after is not None:
+                        payload["retry_after"] = e.retry_after
+                    self._send(503, payload, retry_after=e.retry_after)
                 except AdmissionError as e:
                     self._send(429, {"error": str(e)})
                 except EngineUnhealthy as e:
@@ -908,6 +1568,11 @@ class Router:
                 except (KeyError, TypeError, ValueError,
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                except RuntimeError as e:
+                    # Structured terminal errors (redistribution budget
+                    # exhausted, engine give-ups) reach the client as
+                    # JSON, never a stdlib 500 HTML page.
+                    self._send(503, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self._http_thread = threading.Thread(

@@ -123,7 +123,7 @@ class Request:
 
     id: int = field(default_factory=lambda: next(_ids))
     submitted_at: float = field(default_factory=time.monotonic)
-    state: str = "queued"  # queued | active | done | expired | error
+    state: str = "queued"  # queued | active | done | expired | error | shed
     slot: int = -1
     step: int = 0          # tokens sampled so far (the fold_in counter)
     tokens: list = field(default_factory=list)
@@ -150,6 +150,13 @@ class Request:
     # place — the router adopts the request into a decode replica.
     # Cleared at export so a later preempt-resume decodes where it is.
     migration_sink: object = None
+    # Overload control (serving/overload.py): ``retry_after`` rides a
+    # shed request's structured 503 (state == "shed"); the router's
+    # hedging path sets ``cancel_requested`` on the losing duplicate so
+    # the serving loop drops it (queued or active) without billing its
+    # tenant an SLO miss — the canceller clears ``observer`` first.
+    retry_after: Optional[float] = None
+    cancel_requested: bool = False
     admitted_at: Optional[float] = None
     error: Optional[str] = None
     first_token_at: Optional[float] = None
@@ -431,6 +438,11 @@ class TenantScheduler:
                     if self._metrics is not None:
                         self._metrics.record_expiry()
                     continue
+                if req.cancel_requested:
+                    # A hedging loser: the router already stopped
+                    # reading this stream and cleared its observer.
+                    req.finish("error", "cancelled: hedge superseded")
+                    continue
                 cfg = self._cfg(tenant)
                 self._passes[tenant] = (
                     self._passes.get(tenant, 0.0) + 1.0 / cfg.weight
@@ -474,6 +486,38 @@ class TenantScheduler:
     def active_counts(self) -> Dict[str, int]:
         with self._lock:
             return {t: n for t, n in self._active.items() if n}
+
+    def shed_queued(self, below_priority: int,
+                    retry_after: Optional[float] = None,
+                    cause: str = "overload") -> int:
+        """Shed every QUEUED request with ``priority`` strictly below
+        ``below_priority`` — the degradation ladder's rung-4 action.
+        Each victim finishes in the structured ``shed`` state (the
+        client sees a 503 + ``retry_after``, never a hang); requests
+        already holding slots are untouched (byte-identity contract:
+        running streams finish undegraded).  Returns the shed count."""
+        with self._lock:
+            victims = []
+            for q in self._queues.values():
+                keep = [e for e in q if e[2].priority >= below_priority]
+                if len(keep) != len(q):
+                    victims.extend(
+                        e[2] for e in q if e[2].priority < below_priority
+                    )
+                    q[:] = keep
+                    heapq.heapify(q)
+            self._total_queued -= len(victims)
+        for req in victims:
+            req.retry_after = retry_after
+            req.finish(
+                "shed",
+                f"request {req.id} (tenant '{req.tenant}', priority "
+                f"{req.priority}) shed from the queue under overload "
+                f"({cause}); retry after {retry_after}s",
+            )
+            if self._metrics is not None:
+                self._metrics.record_shed(req.tenant)
+        return len(victims)
 
     def drain_pending(self) -> list:
         """Pop and return EVERY queued request (no slot assignment) — the

@@ -47,12 +47,23 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
-from typing import List
+import zlib
+from typing import List, Optional
 
 import jax
 import numpy as np
 
 from ml_trainer_tpu.generate import _COMPILED
+
+
+class MigrationCorrupt(ValueError):
+    """A KV migration payload failed its per-layer CRC32 check — the
+    pages in flight are NOT the pages the source exported.  The router
+    retries the adoption on a fallback decode candidate (a fresh
+    serialization) instead of silently adopting garbage; a payload that
+    stays corrupt falls back to requeue-and-reprefill.  Mirrors the
+    checkpoint CRC discipline (checkpoint/: every restored leaf is
+    CRC-verified, corrupt dirs are quarantined)."""
 
 
 def _leaf_name(path):
@@ -81,11 +92,35 @@ class KVSlotExport:
     step_counter: int       # per-token fold counter (_steps mirror)
     # -- payload ---------------------------------------------------------
     layers: List[np.ndarray]
+    # Per-layer CRC32 of the page payload, computed at export.  None on
+    # hand-built exports (unit tests); every real export carries them
+    # and import/deserialization verify before any page is scattered.
+    crc32s: Optional[List[int]] = None
 
     def nbytes(self) -> int:
         """Device-payload bytes this migration moves (the metered
         quantity; host metadata is noise next to the K/V pages)."""
         return int(sum(a.nbytes for a in self.layers))
+
+    def verify(self) -> None:
+        """Recompute every layer's CRC32 against the export-time value;
+        raises :class:`MigrationCorrupt` naming the first bad layer.
+        No-op when the export carries no checksums."""
+        if self.crc32s is None:
+            return
+        if len(self.crc32s) != len(self.layers):
+            raise MigrationCorrupt(
+                f"kv migration payload corrupt: {len(self.layers)} "
+                f"layer(s) but {len(self.crc32s)} checksum(s)"
+            )
+        for i, (arr, want) in enumerate(zip(self.layers, self.crc32s)):
+            got = zlib.crc32(np.ascontiguousarray(arr).tobytes())
+            if got != want:
+                raise MigrationCorrupt(
+                    f"kv migration payload corrupt: layer {i} CRC32 "
+                    f"{got:#010x} != exported {want:#010x} "
+                    f"({arr.nbytes} bytes) — refusing to adopt"
+                )
 
 
 def _pool_leaf_paths(cache) -> list:
@@ -149,6 +184,9 @@ def export_kv_slot(engine, slot: int) -> KVSlotExport:
         rng_key=np.asarray(engine._rngs[slot], np.uint32).copy(),
         step_counter=int(engine._steps[slot]),
         layers=layers,
+        crc32s=[
+            zlib.crc32(np.ascontiguousarray(a).tobytes()) for a in layers
+        ],
     )
 
 
@@ -175,6 +213,10 @@ def import_kv_slot(engine, req, slot: int, exp: KVSlotExport) -> str:
             f"{exp.max_len}), target is {pool.page_size} x "
             f"{pool.pages_per_slot} (max_len {engine.max_len})"
         )
+    # CRC gate BEFORE any page allocates or scatters: a corrupt payload
+    # must never become resident K/V (silent garbage would decode into
+    # plausible-looking wrong tokens).
+    exp.verify()
     paths = _pool_leaf_paths(engine.cache)
     if len(paths) != len(exp.layers):
         raise ValueError(
@@ -284,6 +326,13 @@ def to_bytes(exp: KVSlotExport) -> bytes:
         "temperature": exp.temperature,
         "step_counter": exp.step_counter,
         "n_layers": len(exp.layers),
+        "crc32s": (
+            list(exp.crc32s) if exp.crc32s is not None
+            else [
+                zlib.crc32(np.ascontiguousarray(a).tobytes())
+                for a in exp.layers
+            ]
+        ),
     }
     buf = io.BytesIO()
     np.savez(
@@ -296,7 +345,30 @@ def to_bytes(exp: KVSlotExport) -> bytes:
     return buf.getvalue()
 
 
-def from_bytes(payload: bytes) -> KVSlotExport:
+def from_bytes(payload: bytes, verify: bool = True) -> KVSlotExport:
+    """Deserialize (and by default CRC-verify) a migration payload.
+    Raises :class:`MigrationCorrupt` when the container is undecodable
+    or a layer's bytes do not match the checksum the exporter wrote —
+    the transport (or an injected ``migration_corrupt`` fault) damaged
+    the pages in flight."""
+    import zipfile
+
+    try:
+        exp = _from_bytes_unchecked(payload)
+    except MigrationCorrupt:
+        raise
+    except (ValueError, OSError, KeyError, zipfile.BadZipFile,
+            zlib.error, json.JSONDecodeError) as e:
+        raise MigrationCorrupt(
+            f"kv migration payload corrupt: undecodable container "
+            f"({type(e).__name__}: {e})"
+        ) from e
+    if verify:
+        exp.verify()
+    return exp
+
+
+def _from_bytes_unchecked(payload: bytes) -> KVSlotExport:
     with np.load(io.BytesIO(payload), allow_pickle=False) as z:
         meta = json.loads(bytes(z["meta"].tobytes()).decode())
         return KVSlotExport(
@@ -314,4 +386,5 @@ def from_bytes(payload: bytes) -> KVSlotExport:
             layers=[
                 z[f"layer_{i}"] for i in range(int(meta["n_layers"]))
             ],
+            crc32s=[int(c) for c in meta.get("crc32s", [])] or None,
         )

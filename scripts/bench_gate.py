@@ -660,6 +660,111 @@ def gate_disagg(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_overload_reference(repo: str = REPO):
+    """Mitigated TTFT attainment from the committed serving-chaos
+    artifact (docs/serving_chaos_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "serving_chaos_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = (data.get("mitigated") or {}).get("ttft_attainment")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_overload(threshold: float, backend: str, fp: str) -> dict:
+    """The overload/chaos regression gate: a short run of the serving
+    chaos leg (1-of-4 replicas killed + one slowed mid-run, recorded
+    trace open-loop at saturating load, with vs without the
+    autoscaler + hedging + breaker + ladder stack), gated —
+
+    1. **Invariants** (hard): zero byte-identity regressions on
+       surviving streams (degraded outputs must equal their
+       un-degraded prefix), zero compiles during either chaos leg,
+       every shed/failed request structured (JSON status + cause,
+       retry_after on sheds), migrations actually flowed, and the
+       mitigation stack beat the no-mitigation baseline by >= 1.3x
+       TTFT attainment (the committed artifact pins the full >= 2x
+       win; a short gate run keeps a looser floor against scheduler
+       noise).
+    2. **Chaos-attainment ratchet**: the mitigated leg's TTFT
+       attainment vs the committed artifact / this machine's recorded
+       best, the calibrate-then-ratchet fallback the other gates use.
+    """
+    import bench
+
+    result = bench.bench_serve_chaos(n_requests=48, slow_secs=8.0)
+    out = {
+        "baseline_attainment": result["baseline"]["ttft_attainment"],
+        "mitigated_attainment": result["mitigated"]["ttft_attainment"],
+        "attainment_ratio": result["attainment_ratio"],
+        "hedges": result["mitigated"]["hedges"],
+        "autoscaler_actions": result["run_report"]["autoscaler_actions"],
+        "threshold": threshold,
+    }
+    if not result["byte_identity_ok"]:
+        out.update(ok=False, decided_by="identity",
+                   error="surviving streams diverged from reference")
+        return out
+    if not result["zero_recompiles"]:
+        out.update(
+            ok=False, decided_by="zero_recompile",
+            error="compiles observed during a chaos leg: "
+            + str(result["baseline"].get("recompile_error")
+                  or result["mitigated"].get("recompile_error")),
+        )
+        return out
+    if not result["all_failures_structured"]:
+        out.update(
+            ok=False, decided_by="structured_errors",
+            error=f"unstructured failures: baseline "
+            f"{result['baseline']['unstructured_failures']}, mitigated "
+            f"{result['mitigated']['unstructured_failures']}",
+        )
+        return out
+    if result["mitigated"]["migrations"] < result["n_requests"]:
+        out.update(
+            ok=False, decided_by="migration_coverage",
+            error=f"only {result['mitigated']['migrations']} "
+            f"migration(s) for {result['n_requests']} requests",
+        )
+        return out
+    if result["attainment_ratio"] < 1.3:
+        out.update(
+            ok=False, decided_by="mitigation_floor",
+            error=f"mitigated attainment only "
+            f"{result['attainment_ratio']}x baseline under chaos "
+            "(gate floor 1.3x; the committed artifact pins 2x)",
+        )
+        return out
+    committed = committed_overload_reference()
+    key = f"{backend}_serve_chaos"
+    baseline = load_baseline(key, fp)
+    decision = evaluate(
+        float(result["mitigated"]["ttft_attainment"]),
+        committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            key, fp,
+            max(float(result["mitigated"]["ttft_attainment"]),
+                baseline or 0.0),
+        )
+    elif "error" not in out:
+        out["error"] = (
+            f"mitigated chaos attainment "
+            f"{result['mitigated']['ttft_attainment']} is "
+            f">{threshold * 100:.0f}% below this machine's baseline "
+            f"{baseline}"
+        )
+    return out
+
+
 def committed_goodput_reference(repo: str = REPO):
     """The committed memory/goodput artifact
     (docs/memory_goodput_cpu.json), or None."""
@@ -947,6 +1052,9 @@ def main() -> int:
                         help="skip the serving-SLO open-loop gate")
     parser.add_argument("--skip-disagg", action="store_true",
                         help="skip the disaggregated-serving router gate")
+    parser.add_argument("--skip-overload", action="store_true",
+                        help="skip the serving-chaos overload gate "
+                        "(autoscaler + hedging + ladder vs baseline)")
     parser.add_argument("--skip-goodput", action="store_true",
                         help="skip the memory-ledger / goodput / "
                         "recompile gate")
@@ -1061,6 +1169,21 @@ def main() -> int:
             f"disaggregated {disagg['disagg_tokens_per_sec']} tokens/s, "
             f"TTFT p99 ratio {disagg['ttft_p99_ratio']} vs colocated, "
             f"{disagg['migrations']} migration(s)",
+            flush=True,
+        )
+    if not args.skip_overload:
+        ov = gate_overload(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_overload": ov}), flush=True)
+        if not ov["ok"]:
+            print(f"BENCH_GATE OVERLOAD FAIL: {ov.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE OVERLOAD OK ({ov['decided_by']}): chaos "
+            f"attainment {ov['mitigated_attainment']} mitigated vs "
+            f"{ov['baseline_attainment']} baseline "
+            f"({ov['attainment_ratio']}x), {ov['hedges']} hedge(s), "
+            f"autoscaler {ov['autoscaler_actions']}",
             flush=True,
         )
     if not args.skip_goodput:

@@ -27,6 +27,12 @@
 # identity through page-granular KV migration, router_* metrics on the
 # /metrics scrape, session stickiness, replica-kill
 # drain-and-redistribute with structured errors past the budget), an
+# overload/failure-survival smoke leg (scripts/overload_smoke.py:
+# real HTTP fleet — circuit breaker opens on an injected wedge with
+# byte-identical redistribution, degradation ladder engages/exits with
+# structured 503 + Retry-After sheds, SLO-burn autoscaler replaces a
+# killed replica, and serving_degradation_level / router_hedges_total /
+# router_breaker_state / autoscaler_actions_total land on /metrics), an
 # elastic-training smoke leg (scripts/elastic_smoke.py
 # --quick: kill 1 of 2 simulated hosts mid-run; the same fit() drains,
 # reshapes 8 -> 4 devices and finishes with the uninterrupted
@@ -53,7 +59,11 @@
 # docs/serving_slo_cpu.json), and the disaggregated-router gate
 # (byte identity between topologies, zero recompiles, migration
 # coverage, disaggregated tokens/s ratchet vs
-# docs/serving_disagg_cpu.json; --skip-disagg to skip).
+# docs/serving_disagg_cpu.json; --skip-disagg to skip), and the
+# overload gate (serving chaos: kill + slow with vs without the
+# mitigation stack — identity/recompile/structured-error invariants
+# hard, mitigated-vs-baseline attainment floor, chaos-attainment
+# ratchet vs docs/serving_chaos_cpu.json; --skip-overload to skip).
 #
 #   ./scripts/fastlane.sh            # from the repo root
 #
@@ -98,6 +108,10 @@ echo "# disaggregated-router smoke leg"
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/router_smoke.py
 router_rc=$?
 [ $router_rc -ne 0 ] && echo "# router smoke FAILED (rc=$router_rc)"
+echo "# overload/failure-survival smoke leg"
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/overload_smoke.py
+overload_rc=$?
+[ $overload_rc -ne 0 ] && echo "# overload smoke FAILED (rc=$overload_rc)"
 echo "# elastic-training smoke leg (--quick: in-process reshape only;"
 echo "# the bench gate's gate_elastic runs the full cross-process leg)"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py --quick
@@ -118,7 +132,7 @@ else
   ruff_rc=0
 fi
 echo "# bench regression gate"
-timeout -k 10 1800 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 2100 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -130,6 +144,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$memory_rc
 [ $rc -eq 0 ] && rc=$slo_rc
 [ $rc -eq 0 ] && rc=$router_rc
+[ $rc -eq 0 ] && rc=$overload_rc
 [ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc

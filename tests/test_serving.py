@@ -239,9 +239,9 @@ def test_health_payload_golden_shape(model_and_vars):
         payload = server.health()
     assert sorted(payload) == [
         "active_requests", "active_slots", "adoptions_pending",
-        "closed", "draining", "healthy", "kv_pages_free",
-        "kv_pages_total", "max_slots", "ok", "queue_depth",
-        "queued_requests", "reason", "role",
+        "closed", "degradation_level", "draining", "healthy",
+        "kv_pages_free", "kv_pages_total", "max_slots", "ok",
+        "queue_depth", "queued_requests", "reason", "role",
     ]
     assert payload["ok"] is True and payload["role"] == "decode"
     assert payload["active_slots"] == 0 and payload["queue_depth"] == 0
