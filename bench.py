@@ -723,6 +723,279 @@ def bench_slo(rates=(40.0, 120.0, 360.0, 720.0), n_requests=36, seed=0,
     return result
 
 
+def bench_serve_lora(n_adapters=64, n_requests=96, rate_rps=400.0,
+                     max_batch=8, page_size=16, rank=8, seed=0,
+                     out_path=None, target_url=None):
+    """Batched-LoRA serving leg (docs/serving.md "Batched LoRA
+    adapters"): ``n_adapters`` concurrent adapters over ONE gpt2 base,
+    open-loop at saturating load through the real HTTP server, vs the
+    single-model baseline on the identical schedule.
+
+    Method guards:
+
+    * **Identical traffic.**  One seeded Poisson schedule whose
+      requests draw uniformly from {base, adapter_00..} plus a shared
+      system prefix; the baseline server runs the SAME schedule with
+      every adapter field stripped — so the ratio prices exactly the
+      per-row gather + low-rank delta, not a workload difference.
+    * **Byte identity.**  Every ``adapter=None`` request's output on
+      the LoRA server must equal the baseline server's output for the
+      same request (the trash-slot-0 zero-delta contract).
+    * **Hot-load mid-run.**  A brand-new adapter registers and serves
+      DURING the timed pass, inside ``compile_watch.expect_no_compiles``
+      — the one warm upload program plus the rank bucket make the load
+      a pure data movement.
+    * **Mixed ranks.**  Adapters alternate trained rank ``rank/2`` and
+      ``rank`` (zero-padded into the one bucket), so the zero-recompile
+      pin covers the mixed-rank case.
+
+    ``target_url`` points the same schedule at an EXTERNAL target
+    (``bench.py --serve-lora-url http://host:port`` — e.g. a router
+    fleet built with adapter pools); rows then carry client-side truth
+    only and no artifact is written.
+    """
+    import os
+    import tempfile
+
+    from ml_trainer_tpu.lora import LoraConfig, export_lora_artifact
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import (
+        AdapterConfig, Server, TenantLoad, poisson_schedule,
+        run_open_loop,
+    )
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    # gpt2_mini (512-wide): wide enough that a rank-8 delta is the
+    # production-shaped small fraction of the base matmul — on the
+    # 128-wide test config the gather+delta is a third of the whole
+    # step and the ratio measures the toy width, not the design.
+    model = get_model("gpt2_mini", max_len=256)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    targets = ("qkv", "proj")
+    names = [f"a{i:02d}" for i in range(n_adapters - 1)]
+
+    # Fabricate adapter artifacts: train-mode init (A small, B zeros)
+    # with B given real mass, alternating trained ranks — small enough
+    # that tokens stay plausible, large enough that outputs differ.
+    tmp = tempfile.mkdtemp(prefix="bench_lora_")
+    rng = np.random.default_rng(seed)
+
+    def make_artifact(name, r, scale=0.5):
+        lm = model.clone(lora_rank=r, lora_alpha=float(2 * r),
+                         lora_targets=targets)
+        params = jax.device_get(lm.init(
+            {"params": jax.random.PRNGKey(1)},
+            np.zeros((1, 8), np.int32), train=False,
+        )["params"])
+
+        def bump(node):
+            out = {}
+            for k, v in node.items():
+                if hasattr(v, "items"):
+                    out[k] = bump(v)
+                elif "_lora_B" in k:
+                    out[k] = rng.standard_normal(
+                        v.shape
+                    ).astype(np.float32) * scale
+                else:
+                    out[k] = v
+            return out
+
+        path = os.path.join(tmp, f"{name}.npz")
+        export_lora_artifact(
+            bump(dict(params)),
+            LoraConfig(rank=r, alpha=float(2 * r), targets=targets),
+            path, name=name,
+        )
+        return path
+
+    sources = {
+        n: make_artifact(n, rank if i % 2 else rank // 2)
+        for i, n in enumerate(names)
+    }
+    hot_path = make_artifact("hot", rank)
+
+    # ~20% base traffic interleaved with the adapter mix; the first
+    # len(names) arrivals are then pinned to cover EVERY adapter once,
+    # so the pool genuinely holds n_adapters concurrent residents.
+    # shared_frac is modest: per-adapter prefix namespacing (correct by
+    # construction — K/V is adapter-specific) means 64-way traffic
+    # cannot share the system prefix the way one model can, and the
+    # ratio should price the GATHER, not mostly that hit-rate delta.
+    mix = TenantLoad(
+        weight=1.0, prompt_len=(8, 24), output_len=(4, 16),
+        shared_prefix_len=16, shared_frac=0.25,
+        adapters=(None,) * (len(names) // 4) + tuple(names),
+    )
+    schedule = poisson_schedule(
+        float(rate_rps), n_requests, model.vocab_size,
+        tenants={"mix": mix}, seed=seed,
+    )
+    import dataclasses as _dc
+
+    schedule = [
+        _dc.replace(s, adapter=names[i]) if i < len(names) else s
+        for i, s in enumerate(schedule)
+    ]
+    base_schedule = [_dc.replace(s, adapter=None) for s in schedule]
+
+    if target_url is not None:
+        for _ in range(2):
+            run_open_loop(schedule, url=target_url, time_scale=0.0)
+        client = run_open_loop(schedule, url=target_url)
+        client.pop("per_request")
+        return {
+            "target_url": target_url,
+            "n_adapters": n_adapters,
+            "tokens_per_sec": client["tokens_per_sec"],
+            "n_errors": client["n_errors"],
+            "client": client,
+        }
+
+    def serve(schedule_, srv):
+        host, port = srv.serve_http(port=0)
+        url = f"http://{host}:{port}"
+        for _ in range(2):          # compiles + prefix cache + adapter
+            run_open_loop(schedule_, url=url, time_scale=0.0)  # loads
+        err = None
+        hot_result = {}
+        snap0 = srv.metrics.snapshot()
+
+        def hot_load():
+            # The hot-load protocol under live traffic: a NEVER-seen
+            # adapter registers mid-pass and serves immediately.
+            if srv.engine.adapters is None:
+                return
+            time.sleep(0.2)
+            srv.load_adapter("hot", hot_path)
+            p = np.asarray(schedule_[0].prompt, np.int32)
+            out = srv.complete(p, 8, adapter="hot", timeout=300)
+            hot_result["tokens"] = int(np.asarray(out).size - p.size)
+
+        import threading
+
+        try:
+            with compile_watch.expect_no_compiles("lora timed pass"):
+                hot = threading.Thread(target=hot_load, daemon=True)
+                hot.start()
+                client = run_open_loop(
+                    schedule_, url=url, collect_tokens=True
+                )
+                hot.join(timeout=300)
+        except AssertionError as e:
+            err = str(e)
+            client = run_open_loop(schedule_, url=url, collect_tokens=True)
+        snap = srv.metrics.snapshot()
+        # Device-busy tokens/s over the timed pass only (cumulative
+        # counters, so delta vs the pre-pass snapshot): the engine-side
+        # rate, far less noisy than client makespan on a shared
+        # container — what the single-model ratio is judged on.
+        d_tokens = snap["tokens_total"] - snap0["tokens_total"]
+        busy0 = (
+            snap0["tokens_total"] / snap0["tokens_per_sec_busy"]
+            if snap0["tokens_per_sec_busy"] else 0.0
+        )
+        busy1 = (
+            snap["tokens_total"] / snap["tokens_per_sec_busy"]
+            if snap["tokens_per_sec_busy"] else 0.0
+        )
+        snap["timed_tokens_per_sec_busy"] = round(
+            d_tokens / (busy1 - busy0), 1
+        ) if busy1 > busy0 else 0.0
+        return client, snap, err, hot_result
+
+    compile_watch.install()
+    with Server(model, variables, max_batch=max_batch,
+                max_queue=2 * n_requests, kv_page_size=page_size) as srv:
+        base_client, base_snap, base_err, _ = serve(base_schedule, srv)
+    print(
+        f"# serve lora single-model baseline: "
+        f"{base_client['tokens_per_sec']:,.1f} tokens/s", flush=True,
+    )
+    with Server(model, variables, max_batch=max_batch,
+                max_queue=2 * n_requests, kv_page_size=page_size,
+                adapters=AdapterConfig(
+                    slots=n_adapters + 2, rank=rank, targets=targets,
+                    sources=sources,
+                )) as srv:
+        lora_client, lora_snap, lora_err, hot_result = serve(
+            schedule, srv
+        )
+        resident = srv.health()["adapters_resident"]
+    ratio = (
+        lora_snap["timed_tokens_per_sec_busy"]
+        / base_snap["timed_tokens_per_sec_busy"]
+        if base_snap["timed_tokens_per_sec_busy"] else 0.0
+    )
+    print(
+        f"# serve lora {n_adapters} adapters:       "
+        f"{lora_snap['timed_tokens_per_sec_busy']:,.1f} busy tokens/s "
+        f"vs {base_snap['timed_tokens_per_sec_busy']:,.1f} single-model "
+        f"({ratio:.2f}x), {len(resident)} resident, hot-load "
+        f"{'ok' if hot_result.get('tokens') else 'MISSING'}", flush=True,
+    )
+
+    # Byte identity: every adapter=None request equal across servers.
+    identical = True
+    n_base_rows = 0
+    for s, lr, br in zip(schedule, lora_client["per_request"],
+                         base_client["per_request"]):
+        if s.adapter is not None:
+            continue
+        n_base_rows += 1
+        if lr.get("output") != br.get("output"):
+            identical = False
+    result = {
+        "n_adapters": n_adapters,
+        "adapters_resident": len(resident),
+        "rank_bucket": rank,
+        "mixed_ranks": [rank // 2, rank],
+        "targets": list(targets),
+        "n_requests": n_requests,
+        "offered_rps": float(rate_rps),
+        "lora": {
+            "tokens_per_sec": lora_client["tokens_per_sec"],
+            "tokens_per_sec_busy": lora_snap["timed_tokens_per_sec_busy"],
+            "client_e2e_p99_ms": lora_client["client_e2e_p99_ms"],
+            "n_errors": lora_client["n_errors"],
+            "adapter_hits": lora_snap["adapter_hits_total"],
+            "adapter_loads": lora_snap["adapter_loads_total"],
+            "adapter_evictions": lora_snap["adapter_evictions_total"],
+            "adapter_pool_bytes": lora_snap["adapter_pool_bytes"],
+            "prefix_hit_rate": lora_snap["prefix_hit_rate"],
+        },
+        "single_model": {
+            "tokens_per_sec": base_client["tokens_per_sec"],
+            "tokens_per_sec_busy": base_snap["timed_tokens_per_sec_busy"],
+            "client_e2e_p99_ms": base_client["client_e2e_p99_ms"],
+            "n_errors": base_client["n_errors"],
+            "prefix_hit_rate": base_snap["prefix_hit_rate"],
+        },
+        "tokens_per_sec_ratio": round(ratio, 3),
+        "base_requests_byte_identical": identical,
+        "n_base_requests_compared": n_base_rows,
+        "hot_load_tokens": hot_result.get("tokens", 0),
+        "zero_recompiles": lora_err is None and base_err is None,
+        "backend": jax.default_backend(),
+    }
+    if lora_err or base_err:
+        result["recompile_error"] = lora_err or base_err
+    if not identical:
+        result["error"] = "adapter=None output diverged from single-model"
+    elif not result["zero_recompiles"]:
+        result["error"] = "compiles observed during a timed pass"
+    elif not hot_result.get("tokens"):
+        result["error"] = "mid-run hot-load did not serve"
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# serve lora artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_serve_disagg(n_requests=48, n_tenants=3, shared_frac=0.8,
                        mean_interarrival=0.002, shared_len=160,
                        page_size=16, max_batch=4, n_prefill=2,
@@ -2400,6 +2673,19 @@ def main():
                         "EXTERNAL target URL (a single replica's front "
                         "end or the disaggregated router's) instead of "
                         "building a local server; no artifact written")
+    parser.add_argument("--serve-lora", action="store_true",
+                        help="run only the batched-LoRA serving leg: 64 "
+                        "concurrent adapters over one gpt2 base, open-"
+                        "loop at saturating load vs the single-model "
+                        "baseline on the identical schedule; adapter="
+                        "None byte identity, mid-run hot-load and zero "
+                        "recompiles pinned; writes "
+                        "docs/serving_lora_cpu.json (gpt2_tiny; CPU-safe)")
+    parser.add_argument("--serve-lora-url", default=None, metavar="URL",
+                        help="point the --serve-lora schedule at an "
+                        "EXTERNAL target URL (a replica's front end or "
+                        "an adapter-pooled router fleet's) instead of "
+                        "building a local server; no artifact written")
     parser.add_argument("--serve-disagg", action="store_true",
                         help="run only the disaggregated-vs-colocated "
                         "router comparison: the same recorded 80%%-"
@@ -2554,6 +2840,25 @@ def main():
         )
         result = bench_slo(out_path=out, target_url=args.slo_url)
         print(json.dumps({"slo": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.serve_lora or args.serve_lora_url:
+        # 64 concurrent LoRA adapters over one base vs the single-model
+        # baseline; the artifact is the acceptance evidence for the
+        # batched-adapter subsystem and feeds bench_gate.py gate_lora.
+        # --serve-lora-url redirects the schedule at an external target
+        # (e.g. a router fleet with adapter pools), client-side truth.
+        import os as _os
+
+        out = None if args.serve_lora_url else _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "serving_lora_cpu.json",
+        )
+        result = bench_serve_lora(
+            out_path=out, target_url=args.serve_lora_url
+        )
+        print(json.dumps({"serve_lora": result}))
         if result.get("error"):
             sys.exit(1)
         return

@@ -26,6 +26,7 @@ from ml_trainer_tpu.data import Loader, ArrayDataset, ShardedSampler
 from ml_trainer_tpu.models import MLModel
 from ml_trainer_tpu.utils.utils import load_history, load_model, plot_history
 from ml_trainer_tpu.generate import beam_search, generate, generate_ragged
+from ml_trainer_tpu.lora import LoraConfig
 from ml_trainer_tpu.speculative import (
     DraftModelDrafter,
     NgramDrafter,
@@ -49,6 +50,7 @@ __all__ = [
     "generate_ragged",
     "beam_search",
     "speculative_generate",
+    "LoraConfig",
     "NgramDrafter",
     "DraftModelDrafter",
     "__version__",

@@ -204,6 +204,39 @@ def build_decode_specs(paged: bool = True, spec_k: int = 2,
                 seng._temps, seng._rngs, seng._steps,
             ),
         ))
+
+    # Batched-LoRA programs (serving/adapter_pool.py): the per-row
+    # adapter-gathered decode step and the adapter-aware prefill — the
+    # same contracts (collective uniformity, dtype policy, donation)
+    # must hold with the pool stacks as ordinary inputs.
+    from ml_trainer_tpu.serving.adapter_pool import AdapterConfig
+
+    leng = SlotDecodeEngine(
+        model, variables, max_batch=2,
+        adapters=AdapterConfig(slots=3, rank=4, targets=("qkv", "proj")),
+    )
+    lora_vars = leng._lora_vars(leng._adapter_rows)
+    traced_l = leng._decode.trace(
+        leng.params, leng.cache, leng.tok, leng._temps, leng._rngs,
+        leng._steps, lora_vars,
+    )
+    specs.append(ProgramSpec(
+        name="serve_decode[lora]", traced=traced_l,
+        lower_text=_lower_text_thunk(traced_l) if with_lowered else None,
+    ))
+    lprefill = leng._program(
+        ("serve_prefill", leng._prefill_model, bucket),
+        lambda: leng._build_prefill(bucket, lora=True),
+    )
+    specs.append(ProgramSpec(
+        name=f"serve_prefill[lora,b{bucket}]",
+        traced=lprefill.trace(
+            leng.params, np.zeros((1, bucket), np.int32), np.int32(5),
+            jnp.asarray(0.0, jnp.float32),
+            np.zeros((2,), np.uint32), np.int32(0),
+            leng._lora_vars(leng._adapter_rows[:1]),
+        ),
+    ))
     return specs
 
 

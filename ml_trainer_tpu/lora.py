@@ -1,0 +1,217 @@
+"""LoRA: train low-rank adapters in-stack, serve thousands per base.
+
+One base checkpoint cannot serve millions of users: production traffic
+is thousands of fine-tuned variants (the Gemma-on-TPU serving paper's
+per-chip-cost framing, arXiv 2605.25645), and TorchTitan's
+composable-feature thesis (arXiv 2410.06511) says the train side should
+be a Trainer knob, not a fork.  This module is the shared half of both:
+
+* :class:`LoraConfig` — the ``Trainer(lora=...)`` knob: rank, alpha,
+  targeted Dense projections (models/layers.py ``LORA_TARGETS``).  The
+  Trainer clones the model with the matching ``lora_*`` fields (A/B
+  become ordinary flax params, B zero-init so step 0 IS the base
+  model), freezes the base through an ``optax.multi_transform`` mask —
+  frozen leaves carry NO optimizer state, so optimizer memory divides
+  by the frozen fraction (verified by the memory ledger) — and trains
+  only A/B.
+* **Artifact format** — :func:`export_lora_artifact` /
+  :func:`load_lora_artifact`: one ``.npz`` holding every target's A/B
+  plus a JSON meta record (rank, alpha, targets, base fingerprint).
+  This is the unit the serving engine hot-loads into its adapter pool
+  (serving/adapter_pool.py) under live traffic.
+* :func:`lora_param_labels` / :func:`split_lora_params` — the
+  ``_lora_A``/``_lora_B`` naming convention is the single source of
+  truth for "what is adapter, what is base" on both sides.
+
+Host-side file I/O and tree walks only — no compiled-program surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import os
+import zlib
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ml_trainer_tpu.models.layers import LORA_TARGETS
+
+# Param-name suffixes marking adapter leaves (models/layers.py
+# lora_delta creates them as ``<target>_lora_A`` / ``<target>_lora_B``).
+_LORA_MARKERS = ("_lora_A", "_lora_B")
+
+ARTIFACT_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    """The ``Trainer(lora=...)`` knob.
+
+    ``rank``: adapter rank r (A: [in, r], B: [r, out]).  ``alpha``:
+    scale numerator — the delta is ``alpha/rank · xAB`` (the standard
+    LoRA parameterization, so quality is rank-robust).  ``targets``:
+    which Dense projections carry adapters (default: attention qkv +
+    output proj)."""
+
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = ("qkv", "proj")
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"lora rank must be >= 1, got {self.rank}")
+        if self.alpha <= 0:
+            raise ValueError(f"lora alpha must be > 0, got {self.alpha}")
+        targets = tuple(self.targets)
+        if not targets:
+            raise ValueError("lora targets must name >= 1 projection")
+        bad = [t for t in targets if t not in LORA_TARGETS]
+        if bad:
+            raise ValueError(
+                f"unknown lora target(s) {bad}; choose from {LORA_TARGETS}"
+            )
+        object.__setattr__(self, "targets", targets)
+
+    def scale(self) -> float:
+        return float(self.alpha) / float(self.rank)
+
+
+def is_lora_path(path_name: str) -> bool:
+    """True when a param path names an adapter leaf (A or B)."""
+    return any(m in path_name for m in _LORA_MARKERS)
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(k, "key", k)) for k in path)
+
+
+def lora_param_labels(params) -> Dict:
+    """A tree matching ``params`` labeling each leaf ``'lora'`` or
+    ``'frozen'`` — the ``optax.multi_transform`` mask the Trainer
+    freezes the base with (frozen leaves get ``set_to_zero`` updates
+    AND no optimizer state)."""
+    flat = jax.tree_util.tree_flatten_with_path(params)
+    return jax.tree_util.tree_unflatten(
+        flat[1],
+        ["lora" if is_lora_path(_path_str(p)) else "frozen"
+         for p, _ in flat[0]],
+    )
+
+
+def split_lora_params(params) -> Tuple[Dict[str, np.ndarray], int, int]:
+    """Collect the adapter leaves out of a trained param tree.
+
+    Returns ``(leaves, n_lora, n_frozen)`` where ``leaves`` maps the
+    flat ``a/b/c`` param path to a host array."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    leaves: Dict[str, np.ndarray] = {}
+    n_frozen = 0
+    for path, leaf in flat:
+        name = _path_str(path)
+        if is_lora_path(name):
+            leaves[name] = np.asarray(leaf)
+        else:
+            n_frozen += 1
+    return leaves, len(leaves), n_frozen
+
+
+def strip_lora_params(params):
+    """The BASE-only param tree (every ``*_lora_*`` leaf removed) — what
+    a serving engine's pool-mode model expects as ``params`` (serve-mode
+    adapters live in the "lora" collection, not in params)."""
+    def walk(node):
+        if isinstance(node, dict):
+            return {
+                k: walk(v) for k, v in node.items()
+                if not (isinstance(k, str) and is_lora_path(k))
+            }
+        if hasattr(node, "items"):  # FrozenDict
+            return {
+                k: walk(v) for k, v in node.items()
+                if not (isinstance(k, str) and is_lora_path(k))
+            }
+        return node
+
+    return walk(params)
+
+
+def base_fingerprint(params) -> str:
+    """Cheap stable fingerprint of the FROZEN base weights: CRC32 over
+    each non-LoRA leaf's bytes, combined in path order.  Rides the
+    artifact meta so a server can warn when an adapter trained against
+    a different base checkpoint is loaded."""
+    crc = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = _path_str(path)
+        if is_lora_path(name):
+            continue
+        arr = np.ascontiguousarray(np.asarray(leaf))
+        crc = zlib.crc32(name.encode() + arr.tobytes(), crc)
+    return f"{crc:#010x}"
+
+
+def export_lora_artifact(params, config: LoraConfig, path: str,
+                         name: Optional[str] = None) -> dict:
+    """Write one adapter artifact (``.npz``): every ``*_lora_A``/``_B``
+    leaf from ``params`` plus a JSON meta record.  Returns the meta.
+    The serving pool consumes this via :func:`load_lora_artifact` —
+    the hot-load unit."""
+    leaves, n_lora, _ = split_lora_params(params)
+    if not n_lora:
+        raise ValueError(
+            "params carry no *_lora_A/*_lora_B leaves — was the model "
+            "built with Trainer(lora=LoraConfig(...))?"
+        )
+    meta = {
+        "version": ARTIFACT_VERSION,
+        "name": name or os.path.splitext(os.path.basename(path))[0],
+        "rank": int(config.rank),
+        "alpha": float(config.alpha),
+        "targets": list(config.targets),
+        "base_fingerprint": base_fingerprint(params),
+        "n_leaves": n_lora,
+    }
+    buf = io.BytesIO()
+    np.savez(
+        buf,
+        meta=np.frombuffer(json.dumps(meta).encode(), np.uint8),
+        **{f"leaf::{k}": v for k, v in sorted(leaves.items())},
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as fp:
+        fp.write(buf.getvalue())
+    os.replace(tmp, path)
+    return meta
+
+
+def load_lora_artifact(source) -> Tuple[dict, Dict[str, np.ndarray]]:
+    """Read an adapter artifact — a path, bytes, or an already-loaded
+    ``(meta, leaves)`` pair (passed through).  Returns
+    ``(meta, {param_path: array})``."""
+    if isinstance(source, tuple) and len(source) == 2:
+        return source
+    if isinstance(source, (bytes, bytearray)):
+        data = np.load(io.BytesIO(bytes(source)), allow_pickle=False)
+    else:
+        data = np.load(source, allow_pickle=False)
+    with data as z:
+        meta = json.loads(bytes(z["meta"].tobytes()).decode())
+        leaves = {
+            k[len("leaf::"):]: np.asarray(z[k])
+            for k in z.files if k.startswith("leaf::")
+        }
+    if int(meta.get("version", 0)) != ARTIFACT_VERSION:
+        raise ValueError(
+            f"unsupported lora artifact version {meta.get('version')!r} "
+            f"(this build reads {ARTIFACT_VERSION})"
+        )
+    if len(leaves) != int(meta.get("n_leaves", -1)):
+        raise ValueError(
+            f"lora artifact corrupt: {len(leaves)} leaves, meta says "
+            f"{meta.get('n_leaves')}"
+        )
+    return meta, leaves

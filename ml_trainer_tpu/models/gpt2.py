@@ -106,6 +106,17 @@ class GPT2(nn.Module):
     # shared page pool + per-row page tables (models/layers.py).
     kv_page_size: int = 0
     kv_pages: int = 0
+    # LoRA (models/layers.py lora_delta; docs/serving.md "Batched LoRA
+    # adapters"): rank > 0 adds low-rank deltas on ``lora_targets``.
+    # ``lora_slots == 0`` is TRAIN mode (one trainable adapter as
+    # params); ``lora_slots > 0`` is SERVE mode — the adapter pool
+    # stacks live in the "lora" collection and each batch row gathers
+    # its own adapter through the per-row ``adapter_idx`` vector the
+    # serving engine supplies in that collection.
+    lora_rank: int = 0
+    lora_alpha: float = 1.0
+    lora_slots: int = 0
+    lora_targets: tuple = ()
 
     @nn.compact
     def __call__(self, input_ids, train: bool = False, targets=None):
@@ -124,11 +135,21 @@ class GPT2(nn.Module):
             x, tok_embed = _embed_input(self, input_ids)
         if self.dropout_rate:
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        adapter_idx = None
+        if self.lora_rank and self.lora_slots:
+            # Serving pool mode: the per-row adapter index rides the
+            # "lora" collection next to the pool stacks (the engine
+            # supplies both as ordinary program inputs — swapping which
+            # adapter a row reads never recompiles).
+            adapter_idx = self.variable(
+                "lora", "adapter_idx",
+                lambda: jnp.zeros((input_ids.shape[0],), jnp.int32),
+            ).value
         # remat: recompute each block's activations in the backward pass
         # instead of keeping them in HBM (jax.checkpoint; train arg static).
         Block = remat_block(self.remat, self.remat_policy)
         for i in range(self.depth):
-            x = Block(
+            block = Block(
                 num_heads=self.num_heads, mlp_dim=4 * self.embed_dim,
                 causal=True, dropout_rate=self.dropout_rate, dtype=self.dtype,
                 attention_impl=self.attention_impl, mesh=self.mesh,
@@ -136,8 +157,14 @@ class GPT2(nn.Module):
                 decode=self.decode,
                 decode_max_len=self.max_len if self.decode else 0,
                 kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
+                lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+                lora_slots=self.lora_slots, lora_targets=self.lora_targets,
                 name=f"block{i}",
-            )(x, None, train)
+            )
+            if self.lora_rank:
+                x = block(x, None, train, None, adapter_idx)
+            else:
+                x = block(x, None, train)
         return _tied_head(self, x, tok_embed, targets)
 
 

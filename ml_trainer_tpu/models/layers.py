@@ -24,6 +24,66 @@ import jax.numpy as jnp
 
 from ml_trainer_tpu.ops.attention import attention
 
+# Dense targets a LoRA adapter may attach to (docs/serving.md "Batched
+# LoRA adapters"): the attention and MLP projections.  Embeddings and
+# the tied LM head stay base-only by design.
+LORA_TARGETS = ("qkv", "proj", "fc_in", "fc_out")
+
+
+def lora_delta(mdl: nn.Module, name: str, x, features: int,
+               adapter_idx=None):
+    """Low-rank delta for Dense target ``name``: added AFTER the base
+    projection, so base param paths (and the base program when LoRA is
+    off) are untouched.
+
+    Two modes, selected by the owning module's static fields:
+
+    * **Train** (``lora_rank > 0``, ``lora_slots == 0``): one trainable
+      adapter — params ``<name>_lora_A`` (init N(0, 0.01²)) and
+      ``<name>_lora_B`` (init zeros, so step 0 is the base model
+      exactly), delta ``(x @ A @ B) · alpha/rank``.  The base kernel
+      stays frozen by the Trainer's optimizer mask, not here.
+    * **Serve** (``lora_slots > 0``): a POOL of adapters lives in the
+      ``"lora"`` collection — stacks ``A [S, in, rank]`` /
+      ``B [S, rank, out]`` owned and uploaded by the serving engine
+      (serving/adapter_pool.py) — and every batch row gathers ITS OWN
+      adapter by index: ``(x @ A[idx]) @ B[idx]``.  Slot 0 is the trash
+      adapter (all-zero), so rows with no adapter compute an exact-zero
+      delta and stay bit-identical to the base model.  The alpha/rank
+      scale is folded into ``B`` at upload time, so mixed-rank
+      adapters (zero-padded to the pool's rank bucket) share this ONE
+      program — adapter swap/hot-load never recompiles.
+    """
+    rank = int(mdl.lora_rank)
+    slots = int(mdl.lora_slots)
+    in_dim = x.shape[-1]
+    if slots:
+        A = mdl.variable(
+            "lora", f"{name}_lora_A",
+            lambda: jnp.zeros((slots, in_dim, rank), mdl.dtype),
+        ).value
+        B = mdl.variable(
+            "lora", f"{name}_lora_B",
+            lambda: jnp.zeros((slots, rank, features), mdl.dtype),
+        ).value
+        if adapter_idx is None:
+            # Init trace (no engine-supplied index yet): every row reads
+            # the trash adapter — the zero delta.
+            adapter_idx = jnp.zeros((x.shape[0],), jnp.int32)
+        a = jnp.take(A, adapter_idx, axis=0)         # [B, in, rank]
+        b = jnp.take(B, adapter_idx, axis=0)         # [B, rank, out]
+        xa = jnp.einsum("bsi,bir->bsr", x.astype(a.dtype), a)
+        return jnp.einsum("bsr,bro->bso", xa, b)
+    A = mdl.param(
+        f"{name}_lora_A", nn.initializers.normal(0.01), (in_dim, rank)
+    )
+    B = mdl.param(
+        f"{name}_lora_B", nn.initializers.zeros, (rank, features)
+    )
+    scale = float(mdl.lora_alpha) / rank
+    x = x.astype(mdl.dtype)
+    return (x @ A.astype(mdl.dtype) @ B.astype(mdl.dtype)) * scale
+
 
 class MultiHeadAttention(nn.Module):
     """Self-attention over [B, S, E] with heads split for ops.attention.
@@ -53,15 +113,26 @@ class MultiHeadAttention(nn.Module):
     # memory tracks live tokens and identical prefixes can share pages.
     kv_page_size: int = 0
     kv_pages: int = 0
+    # LoRA (see lora_delta): rank > 0 adds low-rank deltas on the
+    # targeted projections — trainable single-adapter params when
+    # lora_slots == 0, the serving engine's per-row-indexed adapter pool
+    # when lora_slots > 0.
+    lora_rank: int = 0
+    lora_alpha: float = 1.0
+    lora_slots: int = 0
+    lora_targets: tuple = ()
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
+    def __call__(self, x, mask=None, train: bool = False, kv_lens=None,
+                 adapter_idx=None):
         embed = x.shape[-1]
         head_dim = self.head_dim or embed // self.num_heads
         inner = self.num_heads * head_dim
         # Fused QKV projection: one [E, 3·inner] matmul keeps the MXU busy
         # and gives tensor parallelism a single column-sharded kernel.
         qkv = nn.Dense(3 * inner, dtype=self.dtype, name="qkv")(x)
+        if self.lora_rank and "qkv" in self.lora_targets:
+            qkv = qkv + lora_delta(self, "qkv", x, 3 * inner, adapter_idx)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):  # [B, S, inner] -> [B, H, S, D]
@@ -84,7 +155,11 @@ class MultiHeadAttention(nn.Module):
             )
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        attn_out = out
         out = nn.Dense(embed, dtype=self.dtype, name="proj")(out)
+        if self.lora_rank and "proj" in self.lora_targets:
+            out = out + lora_delta(self, "proj", attn_out, embed,
+                                   adapter_idx)
         if self.dropout_rate:
             out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
         return out
@@ -275,16 +350,26 @@ class MLP(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     activation: Callable = nn.gelu
+    # LoRA (see lora_delta / MultiHeadAttention).
+    lora_rank: int = 0
+    lora_alpha: float = 1.0
+    lora_slots: int = 0
+    lora_targets: tuple = ()
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, adapter_idx=None):
         embed = x.shape[-1]
-        x = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc_in")(x)
-        x = self.activation(x)
-        x = nn.Dense(embed, dtype=self.dtype, name="fc_out")(x)
+        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc_in")(x)
+        if self.lora_rank and "fc_in" in self.lora_targets:
+            h = h + lora_delta(self, "fc_in", x, self.hidden_dim,
+                               adapter_idx)
+        h = self.activation(h)
+        out = nn.Dense(embed, dtype=self.dtype, name="fc_out")(h)
+        if self.lora_rank and "fc_out" in self.lora_targets:
+            out = out + lora_delta(self, "fc_out", h, embed, adapter_idx)
         if self.dropout_rate:
-            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
-        return x
+            out = nn.Dropout(self.dropout_rate, deterministic=not train)(out)
+        return out
 
 
 def remat_policy(name: str):
@@ -336,17 +421,28 @@ class TransformerBlock(nn.Module):
     decode_max_len: int = 0
     kv_page_size: int = 0  # >0: paged KV pool (see MultiHeadAttention)
     kv_pages: int = 0
+    # LoRA (see lora_delta): threaded to the attention/MLP projections.
+    lora_rank: int = 0
+    lora_alpha: float = 1.0
+    lora_slots: int = 0
+    lora_targets: tuple = ()
 
     @nn.compact
-    def __call__(self, x, mask=None, train: bool = False, kv_lens=None):
+    def __call__(self, x, mask=None, train: bool = False, kv_lens=None,
+                 adapter_idx=None):
+        lora_kw = dict(
+            lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
+            lora_slots=self.lora_slots, lora_targets=self.lora_targets,
+        ) if self.lora_rank else {}
         attn = lambda y: MultiHeadAttention(
             self.num_heads, causal=self.causal, dropout_rate=self.dropout_rate,
             dtype=self.dtype, attention_impl=self.attention_impl,
             mesh=self.mesh, decode=self.decode,
             decode_max_len=self.decode_max_len,
             kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
-            name="attn",
-        )(y, mask=mask, train=train, kv_lens=kv_lens)
+            name="attn", **lora_kw,
+        )(y, mask=mask, train=train, kv_lens=kv_lens,
+          **({"adapter_idx": adapter_idx} if self.lora_rank else {}))
         if self.moe_experts:
             from ml_trainer_tpu.models.moe import MoEMLP
 
@@ -357,8 +453,9 @@ class TransformerBlock(nn.Module):
         else:
             mlp = lambda y: MLP(
                 self.mlp_dim, dropout_rate=self.dropout_rate, dtype=self.dtype,
-                name="mlp",
-            )(y, train=train)
+                name="mlp", **lora_kw,
+            )(y, train=train,
+              **({"adapter_idx": adapter_idx} if self.lora_rank else {}))
         ln1 = nn.LayerNorm(dtype=self.dtype, name="ln1")
         ln2 = nn.LayerNorm(dtype=self.dtype, name="ln2")
         if self.post_norm:  # BERT-style

@@ -25,6 +25,12 @@ transfer.py, docs/serving.md "Disaggregated serving"):
     out = router.complete(prompt_ids, 64, session="chat-1")
 """
 
+from ml_trainer_tpu.serving.adapter_pool import (
+    AdapterConfig,
+    AdapterPool,
+    AdapterPoolExhausted,
+    UnknownAdapter,
+)
 from ml_trainer_tpu.serving.api import Server, TokenStream
 from ml_trainer_tpu.serving.autoscaler import Autoscaler, AutoscalerConfig
 from ml_trainer_tpu.serving.engine import SlotDecodeEngine
@@ -65,6 +71,10 @@ from ml_trainer_tpu.serving.transfer import (
 )
 
 __all__ = [
+    "AdapterConfig",
+    "AdapterPool",
+    "AdapterPoolExhausted",
+    "UnknownAdapter",
     "Router",
     "Autoscaler",
     "AutoscalerConfig",

@@ -160,7 +160,8 @@ class Server:
                  max_preemptions: int = 8,
                  slo: Optional[SloPolicy] = None,
                  slo_timelines: int = 64,
-                 role: str = "both"):
+                 role: str = "both",
+                 adapters=None):
         """``watchdog_timeout``: seconds the engine loop may go without a
         heartbeat WHILE work is pending before the watchdog declares it
         wedged — fails every in-flight/queued request with a structured
@@ -197,7 +198,17 @@ class Server:
         (serving/router.py): ``"prefill"``, ``"decode"`` or ``"both"``
         (the default — a standalone server serves everything).  The
         role is advertised on ``/healthz`` and is ROUTING POLICY only;
-        the engine itself can always do both."""
+        the engine itself can always do both.
+
+        ``adapters`` (docs/serving.md "Batched LoRA adapters"): an
+        :class:`~ml_trainer_tpu.serving.adapter_pool.AdapterConfig`
+        arming the batched-LoRA pool — requests then name an adapter at
+        ``submit(adapter=...)`` (HTTP ``"adapter"``), each batch row
+        gathers its own low-rank delta inside the one compiled decode
+        program, and ``load_adapter`` hot-loads new artifacts under
+        live traffic with zero recompiles.  ``adapter=None`` traffic
+        reads the all-zero trash slot and stays byte-identical to an
+        adapter-free server."""
         if role not in ("prefill", "decode", "both"):
             raise ValueError(
                 f"role must be 'prefill', 'decode' or 'both', got {role!r}"
@@ -212,7 +223,7 @@ class Server:
             spec_k=spec_k, drafter=drafter, draft_variables=draft_variables,
             kv_page_size=kv_page_size, kv_pages=kv_pages,
             prefix_cache=prefix_cache, prefix_scope=prefix_scope,
-            max_preemptions=max_preemptions,
+            max_preemptions=max_preemptions, adapters=adapters,
         )
         self.scheduler = TenantScheduler(
             max_batch, max_queue=max_queue, metrics=self.metrics,
@@ -280,13 +291,16 @@ class Server:
                temperature: float = 0.0, rng=None,
                eos_token_id: Optional[int] = None,
                deadline: Optional[float] = None,
-               tenant: str = "default", priority: int = 0) -> TokenStream:
+               tenant: str = "default", priority: int = 0,
+               adapter: Optional[str] = None) -> TokenStream:
         """Enqueue one request (thread-safe).  Raises ``AdmissionError``
         when the queue (global or the tenant's) is at its watermark (or
         the server is draining), ``EngineUnhealthy`` when the engine is
         wedged/dead, and ``ValueError`` on a request the engine could
         never serve.  ``tenant``/``priority`` feed the multi-tenant
-        scheduler (higher priority admits first within a tenant)."""
+        scheduler (higher priority admits first within a tenant);
+        ``adapter`` names the LoRA adapter to decode with (needs
+        ``Server(adapters=...)``; None = the base model)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D token array")
@@ -316,14 +330,41 @@ class Server:
         if not isinstance(tenant, str) or not tenant:
             raise ValueError(f"tenant must be a non-empty string, got "
                              f"{tenant!r}")
+        if adapter is not None:
+            if not isinstance(adapter, str) or not adapter:
+                raise ValueError(
+                    f"adapter must be a non-empty string or None, got "
+                    f"{adapter!r}"
+                )
+            if self.engine.adapters is None:
+                raise ValueError(
+                    f"request names adapter '{adapter}' but this server "
+                    "has no adapter pool (construct with "
+                    "Server(adapters=AdapterConfig(...)))"
+                )
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), rng=rng,
             eos_token_id=eos_token_id, deadline=deadline,
-            tenant=tenant, priority=int(priority),
+            tenant=tenant, priority=int(priority), adapter=adapter,
         )
         self.submit_request(req)
         return TokenStream(req, prompt)
+
+    def load_adapter(self, name: str, source) -> dict:
+        """Hot-load (or replace) a LoRA adapter artifact under live
+        traffic (thread-safe).  Registration is host-only — the device
+        upload runs in the engine loop at the adapter's next admission
+        through the one warm compiled scatter, so a hot-load mints no
+        compiles and never stalls running streams.  Returns the
+        artifact meta.  Raises ``ValueError`` when the pool is absent
+        or the artifact does not fit its rank bucket/targets."""
+        if self.engine.adapters is None:
+            raise ValueError(
+                "this server has no adapter pool (construct with "
+                "Server(adapters=AdapterConfig(...)))"
+            )
+        return self.engine.adapters.register(name, source)
 
     def submit_request(self, req: Request) -> None:
         """Enqueue a pre-built :class:`Request` (thread-safe) — the
@@ -528,6 +569,12 @@ class Server:
             "kv_pages_total": (
                 engine.kv_pages - 1 if engine.paged else None
             ),
+            # Adapter-aware router affinity reads this: same-adapter
+            # traffic lands where the adapter is already resident.
+            "adapters_resident": (
+                engine.adapters.resident()
+                if engine.adapters is not None else None
+            ),
         }
 
     def close(self) -> None:
@@ -725,6 +772,11 @@ class Server:
                     engine.pool.free_count() if engine.paged else None
                 ))
                 sched.requeue(req)
+            elif status == "error":
+                # The import finished the request with a structured
+                # error (e.g. an unregistered adapter on this replica);
+                # nothing bound — just hand the slot back.
+                sched.release(slot)
             else:
                 req.mark("adopted", slot=slot)
             progressed = True
@@ -1024,6 +1076,7 @@ class Server:
                         deadline=deadline,
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)),
+                        adapter=body.get("adapter"),
                         # The HTTP wait is capped by the client's own
                         # deadline (plus engine slack): a deadline'd
                         # request gets its 504 near the deadline even

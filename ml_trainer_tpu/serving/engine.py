@@ -145,7 +145,8 @@ class SlotDecodeEngine:
                  kv_page_size: int = 0, kv_pages: int = 0,
                  prefix_cache: bool = True,
                  prefix_scope: str = "tenant",
-                 max_preemptions: int = 8):
+                 max_preemptions: int = 8,
+                 adapters=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if not getattr(model, "max_len", 0):
@@ -204,12 +205,58 @@ class SlotDecodeEngine:
                 raise ValueError("kv_pages needs kv_page_size > 0")
             self.kv_pages = 0
             self._key_model = model
+
+        # -- batched LoRA adapter pool (opt-in; docs/serving.md) --------
+        # The model clones with ``lora_slots > 0``: every targeted Dense
+        # gains pool stacks in the "lora" collection and a per-row
+        # gathered delta — ONE program for any adapter mix, slot 0 the
+        # all-zero trash adapter, so adapter=None rows stay
+        # bit-identical to a LoRA-free engine.
+        self.adapters = None
+        self._lora_on = False
+        self._prefill_model = model
+        if adapters is not None:
+            from ml_trainer_tpu.serving.adapter_pool import (
+                AdapterConfig,
+                AdapterPool,
+            )
+
+            if isinstance(adapters, dict):
+                adapters = AdapterConfig(**adapters)
+            if not isinstance(adapters, AdapterConfig):
+                raise ValueError(
+                    "adapters must be an AdapterConfig (or its kwargs "
+                    f"dict), got {type(adapters).__name__}"
+                )
+            if spec_k:
+                raise ValueError(
+                    "adapters with spec_k > 0 is not supported yet: the "
+                    "speculative verify window does not thread the "
+                    "adapter gather (serve adapters with spec_k=0)"
+                )
+            lora_kw = dict(
+                lora_rank=int(adapters.rank),
+                lora_slots=int(adapters.slots),
+                lora_targets=tuple(adapters.targets),
+            )
+            try:
+                self._key_model = self._key_model.clone(**lora_kw)
+                self._prefill_model = model.clone(**lora_kw)
+            except TypeError as e:
+                raise ValueError(
+                    f"{type(model).__name__} does not carry the lora_* "
+                    "knobs (only the GPT-2 family serves adapters)"
+                ) from e
+            self.adapters = AdapterPool(adapters)  # registers sources
+            self._lora_on = True
         self.dm = self._key_model.clone(decode=True)
         # Prefill ALWAYS runs the contiguous batch-1 program (shared
         # with contiguous engines — and the anchor that keeps paged
         # output byte-identical): its cache is scatter-inserted into the
-        # pages afterwards.
-        self._dm_prefill = model.clone(decode=True)
+        # pages afterwards.  (With adapters the prefill model is the
+        # lora clone: the adapter shapes the cached K/V, so the prefill
+        # program gathers the request's adapter too.)
+        self._dm_prefill = self._prefill_model.clone(decode=True)
         self.params = (
             variables["params"] if "params" in variables else variables
         )
@@ -228,6 +275,54 @@ class SlotDecodeEngine:
         self._temps = np.zeros((max_batch,), np.float32)
         self._rngs = np.zeros((max_batch, 2), np.uint32)
         self._steps = np.zeros((max_batch,), np.int32)
+        # Per-slot adapter index (0 = trash = base model) + the device
+        # stacks the rows gather from.  Stacks are ordinary program
+        # inputs: uploading an adapter into a slot row (the one compiled
+        # scatter below) or repointing a row never recompiles.
+        self._adapter_rows = np.zeros((max_batch,), np.int32)
+        self._lora_stacks = None
+        if self._lora_on:
+            full_shapes = jax.eval_shape(
+                lambda p: self.dm.init(
+                    {"params": p}, jnp.zeros((max_batch, 1), jnp.int32),
+                    train=False,
+                ),
+                jax.random.PRNGKey(0),
+            )
+            stack_shapes = {
+                k: v for k, v in full_shapes["lora"].items()
+                if k != "adapter_idx"
+            }
+            self._lora_stacks = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, s.dtype), stack_shapes
+            )
+            from jax import tree_util as _tu
+
+            flat = _tu.tree_flatten_with_path(self._lora_stacks)
+            self._stack_treedef = flat[1]
+            self._stack_paths = [
+                "/".join(str(getattr(k, "key", k)) for k in p)
+                for p, _ in flat[0]
+            ]
+            self._stack_shapes = {
+                path: tuple(leaf.shape)
+                for path, (_, leaf) in zip(self._stack_paths, flat[0])
+            }
+            self._upload = self._program(
+                ("adapter_upload", self._key_model, max_batch),
+                self._build_adapter_upload,
+            )
+            # Warm the upload program NOW (zeros over the trash slot's
+            # zeros — a no-op write), so the first real hot-load under
+            # live traffic mints no compile.
+            zero_rows = _tu.tree_unflatten(
+                self._stack_treedef,
+                [np.zeros(self._stack_shapes[p][1:], np.float32)
+                 for p in self._stack_paths],
+            )
+            self._lora_stacks = self._upload(
+                self._lora_stacks, zero_rows, np.int32(0)
+            )
         self._active: Dict[int, Request] = {}
         self._step_seq = 0  # decode steps run (the decode_wedge fault clock)
         # Overload control (serving/overload.py, set via
@@ -341,6 +436,17 @@ class SlotDecodeEngine:
     def _build_decode(self):
         dm = self.dm
 
+        if self._lora_on:
+            def step_lora(params, cache, tok, temps, rngs, steps, lora):
+                logits, mut = dm.apply(
+                    {"params": params, "cache": cache, "lora": lora},
+                    tok, train=False, mutable=["cache"],
+                )
+                nxt = _sample_rows(logits[:, -1], temps, rngs, steps)
+                return mut["cache"], nxt[:, None].astype(jnp.int32)
+
+            return jax.jit(step_lora, donate_argnums=(1, 2))
+
         def step(params, cache, tok, temps, rngs, steps):
             logits, mut = dm.apply(
                 {"params": params, "cache": cache}, tok,
@@ -350,6 +456,96 @@ class SlotDecodeEngine:
             return mut["cache"], nxt[:, None].astype(jnp.int32)
 
         return jax.jit(step, donate_argnums=(1, 2))
+
+    # -- batched LoRA adapters (serving/adapter_pool.py) -----------------
+
+    def _build_adapter_upload(self):
+        """The one compiled hot-load program: scatter a prepared A/B row
+        set into slot ``slot`` of every stack leaf.  Stacks are donated
+        (updated in place); static shapes, so loading adapter #1000
+        reuses the program minted at warmup."""
+        def upload(stacks, rows, slot):
+            return jax.tree.map(
+                lambda s, r: s.at[slot].set(jnp.asarray(r, s.dtype)),
+                stacks, rows,
+            )
+
+        return jax.jit(upload, donate_argnums=(0,))
+
+    def _lora_vars(self, idx) -> dict:
+        """The "lora" collection for one dispatch: the shared stacks
+        plus the caller's per-row adapter index vector."""
+        return {
+            **self._lora_stacks,
+            "adapter_idx": jnp.asarray(idx, jnp.int32),
+        }
+
+    def _bind_adapter(self, req: Request, slot: int) -> None:
+        """Pin ``req``'s adapter for its slot lifetime: residency hit
+        repoints the row; a miss uploads the registered artifact into a
+        (possibly LRU-evicted) slot through the warm upload program.
+        Raises ``UnknownAdapter`` / ``AdapterPoolExhausted`` (structured
+        — the caller maps them to a client error, never a hang)."""
+        if not req.adapter:
+            self._adapter_rows[slot] = 0
+            return
+        aslot, upload = self.adapters.acquire(req.adapter)
+        if upload is not None:
+            from jax import tree_util as _tu
+
+            from ml_trainer_tpu.serving.adapter_pool import prepare_upload
+
+            meta, leaves = upload
+            rows = prepare_upload(
+                meta, leaves, self._stack_shapes, self.adapters.rank
+            )
+            rows_tree = _tu.tree_unflatten(
+                self._stack_treedef,
+                [rows[p] for p in self._stack_paths],
+            )
+            self._lora_stacks = self._upload(
+                self._lora_stacks, rows_tree, np.int32(aslot)
+            )
+            req.mark("adapter_loaded", adapter=req.adapter, slot=aslot)
+        self._adapter_rows[slot] = aslot
+        self._push_adapter_metrics()
+
+    def _release_adapter(self, slot: int) -> None:
+        """Drop the slot's adapter pin (idempotent — the row zeroes on
+        release, and row 0 is the unpinned trash adapter)."""
+        if self.adapters is None:
+            return
+        idx = int(self._adapter_rows[slot])
+        if idx:
+            self._adapter_rows[slot] = 0
+            self.adapters.release(idx)
+            self._push_adapter_metrics()
+
+    def _adapter_bytes_per_slot(self) -> int:
+        """Device bytes ONE adapter slot occupies across every stack
+        leaf (A and B, all layers/targets) — the pricing behind
+        ``serving_adapter_pool_bytes{state=}``."""
+        cached = getattr(self, "_bytes_per_adapter_slot", None)
+        if cached is not None:
+            return cached
+        total = sum(
+            int(l.nbytes) for l in jax.tree.leaves(self._lora_stacks)
+        )
+        self._bytes_per_adapter_slot = total // max(self.adapters.slots, 1)
+        return self._bytes_per_adapter_slot
+
+    def _push_adapter_metrics(self) -> None:
+        if self.adapters is None:
+            return
+        pool = self.adapters
+        counters = pool.counters()
+        self.metrics.record_adapters(
+            free=pool.free_count(), used=pool.used_count(),
+            total=pool.slots - 1, resident=pool.resident(),
+            hits=counters["hits"], loads=counters["loads"],
+            evictions=counters["evictions"],
+            bytes_per_slot=self._adapter_bytes_per_slot(),
+        )
 
     def _build_insert(self):
         def insert(cache_big, tok_big, cache1, tok0, slot, true_len):
@@ -414,9 +610,26 @@ class SlotDecodeEngine:
 
         return jax.jit(insert, donate_argnums=(0, 1))
 
-    def _build_prefill(self, bucket: int, dm=None, shapes=None):
+    def _build_prefill(self, bucket: int, dm=None, shapes=None,
+                       lora: bool = False):
         dm = dm if dm is not None else self._dm_prefill
         shapes = shapes if shapes is not None else self._shapes_b1
+
+        if lora:
+            def prefill_lora(params, prompt_pad, true_len, temp, rng,
+                             step0, lora_vars):
+                cache = _empty_cache(shapes)
+                logits, mut = dm.apply(
+                    {"params": params, "cache": cache, "lora": lora_vars},
+                    prompt_pad, train=False, mutable=["cache"],
+                )
+                last = jax.lax.dynamic_index_in_dim(
+                    logits, true_len - 1, axis=1, keepdims=False
+                )
+                tok = _sample_rows(last, temp[None], rng[None], step0[None])
+                return mut["cache"], tok.astype(jnp.int32)
+
+            return jax.jit(prefill_lora)
 
         def prefill(params, prompt_pad, true_len, temp, rng, step0):
             cache = _empty_cache(shapes)
@@ -446,10 +659,11 @@ class SlotDecodeEngine:
         true last position's logits sample the first new token.  The
         shared prefix's prefill is skipped entirely."""
         dm = self.dm
+        lora_on = self._lora_on
         from jax import tree_util
 
         def run(cache_big, tok_big, params, window, true_len, start,
-                page_row, temp, rng, step0, slot):
+                page_row, temp, rng, step0, slot, *lora_rest):
             big_flat, treedef = tree_util.tree_flatten_with_path(cache_big)
             # Batch-1 view: shared pools as-is, this slot's table row and
             # start offset as the [1]-row metadata.
@@ -462,8 +676,11 @@ class SlotDecodeEngine:
                 else:
                     view.append(jnp.full((1,), start, leaf.dtype))
             cache1 = tree_util.tree_unflatten(treedef, view)
+            variables = {"params": params, "cache": cache1}
+            if lora_on:
+                variables["lora"] = lora_rest[0]
             logits, mut = dm.apply(
-                {"params": params, "cache": cache1}, window,
+                variables, window,
                 train=False, mutable=["cache"],
             )
             last = jax.lax.dynamic_index_in_dim(
@@ -517,8 +734,17 @@ class SlotDecodeEngine:
         whether a block is cached (observable via TTFT and the hit-rate
         metrics) never leaks one tenant's prompt or generated content to
         another; ``prefix_scope="global"`` opts a trusted deployment
-        back into one shared trie."""
-        return req.tenant if self.prefix_scope == "tenant" else ""
+        back into one shared trie.
+
+        With adapters enabled the namespace ALWAYS also carries the
+        request's adapter (even under prefix_scope="global"): cached
+        K/V is a function of the adapter that prefilled it, so a hit
+        under adapter X serving adapter Y would be silently-wrong
+        logits, not just a side channel."""
+        ns = req.tenant if self.prefix_scope == "tenant" else ""
+        if self.adapters is not None:
+            ns = f"{ns}\x1fadapter={req.adapter or ''}"
+        return ns
 
     def _page_row(self, slot: int) -> np.ndarray:
         row = np.zeros((self.pool.pages_per_slot,), np.int32)
@@ -532,7 +758,9 @@ class SlotDecodeEngine:
         ``donate``, its WRITTEN full blocks are first registered in the
         prefix cache — a finished request's prompt stays hot for the
         next user, and a preempted victim can re-pin its own pages on
-        resume."""
+        resume.  Also drops the slot's adapter pin (every slot-free
+        path funnels through here, paged or contiguous)."""
+        self._release_adapter(slot)
         if not self.paged:
             return
         chain = self.pool.slot_pages[slot]
@@ -705,6 +933,17 @@ class SlotDecodeEngine:
         running requests free pages)."""
         if slot in self._active:
             raise ValueError(f"slot {slot} is already occupied")
+        if req.adapter and self.adapters is None:
+            # A pool-less engine silently serving an adapter-named
+            # request with BASE weights would be wrong output, not a
+            # capacity problem — structured refusal instead.
+            req.finish(
+                "error",
+                f"request {req.id} names adapter '{req.adapter}' but "
+                "this engine has no adapter pool "
+                "(Server(adapters=AdapterConfig(...)))",
+            )
+            return "finished"
         # Effective prompt: original prompt plus any tokens committed
         # before a preemption — resume is just admission with a longer
         # prompt (and the fold counter picking up where it left off).
@@ -783,6 +1022,23 @@ class SlotDecodeEngine:
             self.pool.bind_slot(slot, shared + pages)
             req.kv_blocked = False
 
+        if self.adapters is not None:
+            from ml_trainer_tpu.serving.adapter_pool import (
+                AdapterPoolExhausted,
+                UnknownAdapter,
+            )
+
+            try:
+                self._bind_adapter(req, slot)
+            except (UnknownAdapter, AdapterPoolExhausted) as e:
+                # Structured error naming the adapter — never a hang;
+                # any KV pages bound above unwind with the slot.
+                if self.paged:
+                    self.pool.reset_slot(slot)
+                    self._push_kv_metrics()
+                req.finish("error", str(e))
+                return "finished"
+
         req.slot = slot
         req.state = "active"
         req.mark(
@@ -855,15 +1111,19 @@ class SlotDecodeEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :p] = prompt
         run = self._program(
-            ("serve_prefill", self.model, bucket),
-            lambda: self._build_prefill(bucket),
+            ("serve_prefill", self._prefill_model, bucket),
+            lambda: self._build_prefill(bucket, lora=self._lora_on),
+        )
+        extra = (
+            (self._lora_vars(self._adapter_rows[slot: slot + 1]),)
+            if self._lora_on else ()
         )
         with span("serve_prefill", prompt_len=p, bucket=bucket, slot=slot,
                   request=req.id, tenant=req.tenant):
             cache1, tok0 = run(
                 self.params, padded, np.int32(p),
                 jnp.asarray(req.temperature, jnp.float32), key,
-                np.int32(done_tokens),
+                np.int32(done_tokens), *extra,
             )
             if self.paged:
                 self.cache, self.tok = self._insert(
@@ -901,6 +1161,10 @@ class SlotDecodeEngine:
             ("serve_prefill_paged", self._key_model, bucket),
             lambda: self._build_prefill_paged(bucket),
         )
+        extra = (
+            (self._lora_vars(self._adapter_rows[slot: slot + 1]),)
+            if self._lora_on else ()
+        )
         with span("serve_prefill_paged", prompt_len=p, prefix_hit=c,
                   bucket=bucket, slot=slot, request=req.id,
                   tenant=req.tenant):
@@ -908,7 +1172,7 @@ class SlotDecodeEngine:
                 self.cache, self.tok, self.params, padded, np.int32(su),
                 np.int32(c), jnp.asarray(self._page_row(slot)),
                 jnp.asarray(req.temperature, jnp.float32), key,
-                np.int32(done_tokens), np.int32(slot),
+                np.int32(done_tokens), np.int32(slot), *extra,
             )
         return tok0
 
@@ -1009,11 +1273,14 @@ class SlotDecodeEngine:
             return preempt_freed + self._step_spec()
         active_before = len(self._active)
         t0 = time.perf_counter()
+        extra = (
+            (self._lora_vars(self._adapter_rows),) if self._lora_on else ()
+        )
         with span("serve_decode", engine_step=self._step_seq,
                   active=active_before, requests=step_requests):
             self.cache, self.tok = self._decode(
                 self.params, self.cache, self.tok,
-                self._temps, self._rngs, self._steps,
+                self._temps, self._rngs, self._steps, *extra,
             )
             # The step's ONE fence: every later read this iteration is
             # host data.  # graft-lint: sync-ok

@@ -53,13 +53,18 @@ class TenantLoad:
     ranges.  ``shared_prefix_len`` > 0 prepends a tenant-wide shared
     prefix (drawn once per schedule from the seed) to ``shared_frac``
     of the tenant's prompts — the system-prompt reuse pattern the radix
-    prefix cache exists for."""
+    prefix cache exists for.  ``adapters`` names the tenant's LoRA
+    adapter mix (docs/serving.md "Batched LoRA adapters"): each request
+    draws one entry uniformly — include ``None`` entries for base-model
+    traffic interleaved with adapter traffic.  The draw rides the
+    recorded trace, so a replay drives the same adapter per request."""
 
     weight: float = 1.0
     prompt_len: tuple = (8, 24)
     output_len: tuple = (4, 16)
     shared_prefix_len: int = 0
     shared_frac: float = 0.0
+    adapters: tuple = ()
 
     def __post_init__(self):
         if self.weight <= 0:
@@ -73,6 +78,11 @@ class TenantLoad:
             raise ValueError(
                 f"shared_frac must be in [0, 1], got {self.shared_frac}"
             )
+        for a in self.adapters:
+            if a is not None and (not isinstance(a, str) or not a):
+                raise ValueError(
+                    f"adapters entries must be names or None, got {a!r}"
+                )
 
 
 @dataclasses.dataclass
@@ -87,6 +97,7 @@ class ScheduledRequest:
     prompt: np.ndarray         # int32 token ids
     max_new_tokens: int
     session: Optional[str] = None
+    adapter: Optional[str] = None  # LoRA adapter (None = base model)
 
 
 def poisson_schedule(rate_rps: float, n_requests: int, vocab_size: int,
@@ -125,9 +136,13 @@ def poisson_schedule(rate_rps: float, n_requests: int, vocab_size: int,
         ).astype(np.int32)
         if name in prefixes and rng.random() < cfg.shared_frac:
             prompt = np.concatenate([prefixes[name], prompt])
+        adapter = None
+        if cfg.adapters:
+            adapter = cfg.adapters[int(rng.integers(len(cfg.adapters)))]
         out.append(ScheduledRequest(
             arrival_s=float(arrivals[i]), tenant=name, prompt=prompt,
             max_new_tokens=int(rng.integers(o_lo, o_hi + 1)),
+            adapter=adapter,
         ))
     return out
 
@@ -141,6 +156,7 @@ def schedule_to_records(schedule: Sequence[ScheduledRequest]) -> list:
             "prompt": [int(t) for t in s.prompt],
             "max_new_tokens": s.max_new_tokens,
             **({"session": s.session} if s.session else {}),
+            **({"adapter": s.adapter} if s.adapter else {}),
         }
         for s in schedule
     ]
@@ -162,6 +178,7 @@ def schedule_from_trace(records) -> List[ScheduledRequest]:
             prompt=np.asarray(r["prompt"], np.int32),
             max_new_tokens=int(r["max_new_tokens"]),
             session=r.get("session"),
+            adapter=r.get("adapter"),
         ))
     out.sort(key=lambda s: s.arrival_s)
     return out
@@ -204,6 +221,7 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
         sent_at = time.monotonic()
         row = {
             "tenant": s.tenant,
+            **({"adapter": s.adapter} if s.adapter else {}),
             "scheduled_s": round(s.arrival_s * time_scale, 6),
             "send_lag_ms": round((sent_at - scheduled_at) * 1e3, 3),
             "ok": False, "error": None, "tokens": 0,
@@ -217,6 +235,8 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
                 }
                 if s.session:
                     payload["session"] = s.session
+                if s.adapter:
+                    payload["adapter"] = s.adapter
                 body = json.dumps(payload).encode()
                 req = urllib.request.Request(
                     f"{url}/v1/generate", data=body,
@@ -231,6 +251,7 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
                 out = server.complete(
                     s.prompt, s.max_new_tokens, tenant=s.tenant,
                     timeout=timeout,
+                    **({"adapter": s.adapter} if s.adapter else {}),
                 )
                 row["tokens"] = int(np.asarray(out).size - s.prompt.size)
                 if collect_tokens:

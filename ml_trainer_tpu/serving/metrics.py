@@ -133,6 +133,19 @@ class ServingMetrics:
         self.prefix_lookup_tokens = 0
         self.preemptions_total = 0
         self.admissions_blocked = 0
+        # Batched LoRA adapter pool (serving/adapter_pool.py): slot
+        # occupancy (free/used/total EXCLUDING the trash slot), resident
+        # count, hit/load/eviction counters, and the device bytes one
+        # slot occupies across every stack leaf — the pricing behind
+        # serving_adapter_pool_bytes{state=}.
+        self.adapter_slots_free = 0
+        self.adapter_slots_used = 0
+        self.adapter_slots_total = 0
+        self.adapters_resident = 0
+        self.adapter_hits = 0
+        self.adapter_loads = 0
+        self.adapter_evictions = 0
+        self.adapter_slot_bytes = 0
         self._tenants: dict = {}
         # Speculative decoding (engine spec mode): acceptance accounting.
         # One histogram entry per (verify step, active slot); keys are
@@ -304,6 +317,25 @@ class ServingMetrics:
             if bytes_per_page is not None:
                 self.kv_page_bytes = int(bytes_per_page)
 
+    def record_adapters(self, free: int, used: int, total: int,
+                        resident, hits: int, loads: int, evictions: int,
+                        bytes_per_slot: Optional[int] = None) -> None:
+        """Adapter-pool snapshot (serving/adapter_pool.py): slot
+        occupancy, cumulative hit/load/eviction counters, and the
+        per-slot device-byte price."""
+        with self._lock:
+            self.adapter_slots_free = int(free)
+            self.adapter_slots_used = int(used)
+            self.adapter_slots_total = int(total)
+            self.adapters_resident = len(resident) if not isinstance(
+                resident, int
+            ) else int(resident)
+            self.adapter_hits = int(hits)
+            self.adapter_loads = int(loads)
+            self.adapter_evictions = int(evictions)
+            if bytes_per_slot is not None:
+                self.adapter_slot_bytes = int(bytes_per_slot)
+
     def record_prefix_stats(self, hits: int, misses: int,
                             hit_tokens: int, lookup_tokens: int) -> None:
         """Cumulative prefix-cache counters (token-weighted hit rate:
@@ -413,6 +445,24 @@ class ServingMetrics:
                 ) if self.prefix_lookup_tokens else 0.0,
                 "preemptions_total": self.preemptions_total,
                 "admissions_blocked": self.admissions_blocked,
+                "adapter_slots_free": self.adapter_slots_free,
+                "adapter_slots_used": self.adapter_slots_used,
+                "adapter_slots_total": self.adapter_slots_total,
+                "adapters_resident": self.adapters_resident,
+                "adapter_hits_total": self.adapter_hits,
+                "adapter_loads_total": self.adapter_loads,
+                "adapter_evictions_total": self.adapter_evictions,
+                # Slot counts priced in device bytes (stack geometry x
+                # dtype): the adapter end of the HBM ledger, beside
+                # kv_pool_bytes.
+                "adapter_pool_bytes": {
+                    "free": self.adapter_slots_free
+                    * self.adapter_slot_bytes,
+                    "used": self.adapter_slots_used
+                    * self.adapter_slot_bytes,
+                    "total": self.adapter_slots_total
+                    * self.adapter_slot_bytes,
+                },
                 "tenants": {
                     name: dict(stats)
                     for name, stats in sorted(self._tenants.items())
@@ -498,6 +548,16 @@ class ServingMetrics:
                             f"per-tenant {fname}",
                             labelnames=("tenant",),
                         ).labels(tenant=tenant).set(float(fval))
+                continue
+            if key == "adapter_pool_bytes":
+                g = r.gauge(
+                    "serving_adapter_pool_bytes",
+                    "LoRA adapter pool device bytes by state "
+                    "(stack geometry x dtype, all targets/layers)",
+                    labelnames=("state",),
+                )
+                for state_name, v in value.items():
+                    g.labels(state=state_name).set(float(v))
                 continue
             if key == "kv_pool_bytes":
                 # Labeled by pool state, next to the kv_pages_* gauges,

@@ -489,12 +489,15 @@ class Router:
                eos_token_id: Optional[int] = None,
                deadline: Optional[float] = None,
                tenant: str = "default", priority: int = 0,
-               session: Optional[str] = None) -> TokenStream:
+               session: Optional[str] = None,
+               adapter: Optional[str] = None) -> TokenStream:
         """Route one request (thread-safe).  The returned stream is the
         same surface ``Server.submit`` gives — tokens arrive as the
         serving replicas produce them, across migration and
         redistribution transparently.  ``session`` pins the request's
-        decode to a sticky replica for multi-turn streams."""
+        decode to a sticky replica for multi-turn streams; ``adapter``
+        names the LoRA adapter (the affinity hash includes it, so
+        same-adapter traffic lands where the adapter is resident)."""
         if self._stopping:
             raise RuntimeError("router is closed")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -527,11 +530,18 @@ class Router:
                     f"({self.max_inflight}); request rejected"
                 )
             self._inflight += 1
+        if adapter is not None and (
+            not isinstance(adapter, str) or not adapter
+        ):
+            raise ValueError(
+                f"adapter must be a non-empty string or None, got "
+                f"{adapter!r}"
+            )
         creq = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             temperature=float(temperature), rng=rng,
             eos_token_id=eos_token_id, deadline=deadline,
-            tenant=tenant, priority=int(priority),
+            tenant=tenant, priority=int(priority), adapter=adapter,
         )
         creq.observer = self.slo.observe
         self.slo.track(creq)
@@ -736,7 +746,8 @@ class Router:
                 **{
                     k: rep.last_health.get(k)
                     for k in ("active_slots", "queue_depth",
-                              "kv_pages_free", "adoptions_pending")
+                              "kv_pages_free", "adoptions_pending",
+                              "adapters_resident")
                 },
             }
             for name, rep in self._replicas.items()
@@ -792,11 +803,20 @@ class Router:
             n: r for n, r in self._replicas.items() if r.placeable()
         }
 
-    def _affinity_key(self, tenant: str, prompt: np.ndarray) -> bytes:
+    def _affinity_key(self, tenant: str, prompt: np.ndarray,
+                      adapter: Optional[str] = None) -> bytes:
+        """Consistent-hash key on ``(tenant, adapter, first KV block)``:
+        same-tenant shared prefixes keep hitting one prefill replica's
+        prefix cache, and same-adapter traffic lands where the adapter
+        is already resident (its pool slot warm, its prefix namespace
+        populated) instead of minting a load on every replica."""
         block = np.asarray(
             prompt[: self._affinity_block], np.int32
         ).tobytes()
-        return tenant.encode() + b"|" + block
+        return (
+            tenant.encode() + b"\x1f" + (adapter or "").encode()
+            + b"|" + block
+        )
 
     def _place(self, creq: Request, session: Optional[str],
                exclude_prefill: Optional[str] = None
@@ -809,7 +829,7 @@ class Router:
         alive = self._alive()
         if not alive:
             raise EngineUnhealthy("no healthy replica available")
-        key = self._affinity_key(creq.tenant, creq.prompt)
+        key = self._affinity_key(creq.tenant, creq.prompt, creq.adapter)
         if self.mode == "colocated":
             pool = {
                 n: r for n, r in alive.items() if n != exclude_prefill
@@ -906,6 +926,7 @@ class Router:
             temperature=creq.temperature, rng=creq.rng,
             eos_token_id=creq.eos_token_id, deadline=deadline,
             tenant=creq.tenant, priority=creq.priority,
+            adapter=creq.adapter,
         )
         shadow.tokens = [int(t) for t in committed]
         shadow.preemptions = creq.preemptions
@@ -1543,6 +1564,7 @@ class Router:
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)),
                         session=str(session) if session else None,
+                        adapter=body.get("adapter"),
                         # The HTTP wait is capped by the client's own
                         # deadline (plus routing slack): a deadline'd
                         # request gets a timely 504, and the remaining

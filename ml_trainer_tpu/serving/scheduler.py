@@ -120,6 +120,11 @@ class Request:
     deadline: Optional[float] = None
     tenant: str = DEFAULT_TENANT
     priority: int = 0
+    # Batched LoRA serving (serving/adapter_pool.py): the named adapter
+    # this request decodes with (None = the base model — slot 0, the
+    # trash adapter, bit-identical to an adapter-free engine).  Rides
+    # shadows/migrations so redistribution keeps the same weights.
+    adapter: Optional[str] = None
 
     id: int = field(default_factory=lambda: next(_ids))
     submitted_at: float = field(default_factory=time.monotonic)
@@ -262,6 +267,7 @@ class Request:
         return {
             "id": self.id,
             "tenant": self.tenant,
+            "adapter": self.adapter,
             "priority": self.priority,
             "state": self.state,
             "prompt_tokens": int(np.asarray(self.prompt).size),

@@ -237,6 +237,33 @@ def import_kv_slot(engine, req, slot: int, exp: KVSlotExport) -> str:
     if pages is None:
         return "no_memory"
     pool.bind_slot(slot, pages)
+    # The migrated stream keeps ITS adapter: bind it on the target (the
+    # registry is fleet-shared, so a residency miss just uploads here).
+    # Pool exhaustion degrades to the requeue path like page pressure;
+    # an unregistered adapter is a structured terminal, never a hang.
+    if engine.adapters is not None:
+        from ml_trainer_tpu.serving.adapter_pool import (
+            AdapterPoolExhausted,
+            UnknownAdapter,
+        )
+
+        try:
+            engine._bind_adapter(req, slot)
+        except AdapterPoolExhausted:
+            pool.reset_slot(slot)
+            return "no_memory"
+        except UnknownAdapter as e:
+            pool.reset_slot(slot)
+            req.finish("error", str(e))
+            return "error"
+    elif req.adapter:
+        pool.reset_slot(slot)
+        req.finish(
+            "error",
+            f"request {req.id} decodes with adapter '{req.adapter}' but "
+            "the adopting replica has no adapter pool",
+        )
+        return "error"
     row = engine._page_row(slot)            # [pages_per_slot], trash-padded
 
     key = ("kv_import", engine._key_model, engine.max_batch)

@@ -709,10 +709,51 @@ def kv_pool_bytes(n_pages: int, page_size: int, num_heads: int,
     )
 
 
+def adapter_pool_bytes(slots: int, rank: int, target_dims,
+                       dtype=jnp.float32) -> float:
+    """Total device bytes of a batched-LoRA adapter pool
+    (serving/adapter_pool.py): per targeted projection instance
+    (one ``(in_dim, out_dim)`` entry in ``target_dims`` PER LAYER) the
+    pool holds stacks ``A [slots, in, rank]`` + ``B [slots, rank, out]``
+    — so ``slots × rank × Σ(in + out) × itemsize``.  The trash slot 0
+    is device memory too, so it counts (the ``kv_pool_bytes`` rule).
+    """
+    itemsize = jnp.dtype(dtype).itemsize
+    total_dims = sum(int(i) + int(o) for i, o in target_dims)
+    return float(slots) * rank * total_dims * itemsize
+
+
+def gpt2_lora_target_dims(model, targets) -> List[Tuple[int, int]]:
+    """The ``(in, out)`` pairs :func:`adapter_pool_bytes` needs for a
+    GPT-2-family config: per layer, qkv ``E -> 3E``, proj ``E -> E``,
+    fc_in ``E -> 4E``, fc_out ``4E -> E``."""
+    e = int(model.embed_dim)
+    per_layer = {
+        "qkv": (e, 3 * e),
+        "proj": (e, e),
+        "fc_in": (e, 4 * e),
+        "fc_out": (4 * e, e),
+    }
+    depth = int(getattr(model, "depth", 0) or 0)
+    return [per_layer[t] for _ in range(depth) for t in targets]
+
+
 def serving_kv_ledger(engine) -> MemoryLedger:
     """Per-device ledger of a serving engine's KV memory (paged pool or
-    contiguous slots) measured from its cache tree metadata."""
+    contiguous slots) measured from its cache tree metadata — plus the
+    LoRA adapter pool's stacks when the engine serves adapters."""
     comps: List[Component] = []
+    if getattr(engine, "_lora_on", False):
+        pool = engine.adapters
+        stack_bytes = sum(
+            _leaf_bytes(l) for l in jax.tree.leaves(engine._lora_stacks)
+        )
+        comps.append(Component(
+            "adapter_pool", stack_bytes, "resident",
+            {"slots": pool.slots, "rank": pool.rank,
+             "targets": list(pool.targets),
+             "bytes_per_slot": int(stack_bytes / max(pool.slots, 1))},
+        ))
     cache_bytes = tree_device_bytes(engine.cache)
     if getattr(engine, "paged", False):
         pool_leaves = [

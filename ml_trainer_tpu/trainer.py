@@ -204,6 +204,7 @@ class Trainer:
         bucket_mb: float = 4.0,
         pipeline_schedule: Optional[str] = None,
         elastic: Any = None,
+        lora: Any = None,
         **config: Any,
     ):
         """``mesh_shape`` / ``sharding_rules`` are TPU-native extensions
@@ -434,7 +435,19 @@ class Trainer:
         faults drive the drain→checkpoint→restart path and the
         topology-flexible restore continues the job at the new shape.
         Requires ``steps_per_execution=1`` (the drain needs the
-        per-batch cursor)."""
+        per-batch cursor).
+
+        ``lora`` (docs/serving.md "Batched LoRA adapters"): a
+        :class:`~ml_trainer_tpu.lora.LoraConfig` (or its kwargs dict)
+        — the model clones with trainable low-rank A/B params on the
+        targeted projections (B zero-init, so step 0 IS the base
+        model), the BASE weights freeze through an optax
+        ``multi_transform`` mask (frozen leaves carry no optimizer
+        state, so optimizer memory divides by the frozen fraction —
+        the memory ledger shows it), and ``export_lora(path)`` writes
+        the adapter artifact the serving engine hot-loads.  Requires a
+        model carrying the ``lora_*`` knobs (the GPT-2 family) and
+        ``dp_update='fused'``."""
         logger.info("Config inputs.", config=config)
         cfg = TrainerConfig.from_kwargs(**config)
         self.config = cfg
@@ -552,6 +565,35 @@ class Trainer:
             if model.schedule != pipeline_schedule:
                 model = model.clone(schedule=pipeline_schedule)
         self.pipeline_schedule = pipeline_schedule
+        self.lora = None
+        if lora is not None:
+            from ml_trainer_tpu.lora import LoraConfig
+
+            if isinstance(lora, dict):
+                lora = LoraConfig(**lora)
+            if not isinstance(lora, LoraConfig):
+                raise ValueError(
+                    f"lora must be a LoraConfig (or its kwargs dict), "
+                    f"got {type(lora).__name__}"
+                )
+            if dp_update == "sharded":
+                raise ValueError(
+                    "lora training uses the fused update: the sharded "
+                    "path's dim-0 partition rule does not cover the "
+                    "masked optimizer state (dp_update='fused')"
+                )
+            if not (hasattr(model, "lora_rank") and hasattr(model, "clone")):
+                raise ValueError(
+                    "lora requires a model carrying the lora_* knobs "
+                    f"(the GPT-2 family); got {type(model).__name__}"
+                )
+            # lora_slots stays 0: train mode — one trainable adapter as
+            # ordinary params (serving pools are the engine's business).
+            model = model.clone(
+                lora_rank=int(lora.rank), lora_alpha=float(lora.alpha),
+                lora_targets=tuple(lora.targets), lora_slots=0,
+            )
+            self.lora = lora
         self.model = model
         self._takes_train = _module_takes_train(model)
         self._takes_targets = _module_takes_targets(model)
@@ -1007,6 +1049,35 @@ class Trainer:
             else optax.identity(),
             self.tx,
         )
+        if self.lora is not None:
+            # Freeze the base: only *_lora_A/*_lora_B leaves reach the
+            # optimizer (clip included — the global norm is the
+            # ADAPTER grads' norm); frozen leaves get set_to_zero
+            # updates and, through optax's masking, NO optimizer state
+            # — so moments shrink to the adapter fraction, which the
+            # memory ledger's opt_state component makes visible.
+            from ml_trainer_tpu.lora import lora_param_labels
+
+            labels = lora_param_labels(params)
+            n_lora = sum(
+                1 for v in jax.tree.leaves(labels) if v == "lora"
+            )
+            if not n_lora:
+                raise ValueError(
+                    "Trainer(lora=...) found no *_lora_A/*_lora_B "
+                    "params — do the configured targets exist on this "
+                    "model?"
+                )
+            self.tx = optax.multi_transform(
+                {"lora": self.tx, "frozen": optax.set_to_zero()},
+                labels,
+            )
+            logger.info(
+                f"LoRA: training {n_lora} adapter leaves (rank "
+                f"{self.lora.rank}, targets {self.lora.targets}); "
+                f"{len(jax.tree.leaves(labels)) - n_lora} base leaves "
+                "frozen with no optimizer state."
+            )
         if cfg.scheduler == "ReduceLROnPlateau":
             self._plateau = PlateauController(cfg.lr)
 
@@ -3368,6 +3439,29 @@ class Trainer:
         accelerator (the ref's ``.cpu()`` side effect is a quirk we fix)."""
         logger.info("Saving the model.")
         ckpt.save_model_variables(model_dir, self._state_variables())
+
+    def export_lora(self, path: str, name: Optional[str] = None) -> dict:
+        """Write the trained adapter as one ``.npz`` artifact — the unit
+        the serving engine hot-loads (``Server.load_adapter``, docs/
+        serving.md "Batched LoRA adapters"): every ``*_lora_A``/``_B``
+        leaf plus a meta record (rank/alpha/targets and the frozen
+        base's fingerprint, so a server can flag a base mismatch).
+        Requires ``Trainer(lora=...)``.  Returns the meta."""
+        if self.lora is None:
+            raise ValueError(
+                "export_lora requires Trainer(lora=LoraConfig(...))"
+            )
+        if self.state is None:
+            raise ValueError("trainer has no state (datasets were not given)")
+        from ml_trainer_tpu.lora import export_lora_artifact
+
+        params = jax.device_get(self.state.params)
+        meta = export_lora_artifact(params, self.lora, path, name=name)
+        logger.info(
+            f"LoRA adapter exported -> {path} "
+            f"({meta['n_leaves']} leaves, rank {meta['rank']})."
+        )
+        return meta
 
     def export_torch(
         self, path: str, ddp_prefix: bool = False, spatial_inputs=None,

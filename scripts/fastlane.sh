@@ -22,7 +22,12 @@
 # Poisson schedule through the real HTTP server — lifecycle latency
 # histograms + attainment/burn-rate exposition, nested request trace
 # spans, forced-preemption flight dump naming request ids with
-# timelines), a disaggregated-router smoke leg
+# timelines), a batched-LoRA serving smoke leg (scripts/lora_smoke.py:
+# 8 adapters + base traffic interleaved over the real HTTP server —
+# adapter=None byte identity, per-adapter prefix-cache isolation,
+# hot-load under live load with zero recompiles, adapter pool gauges on
+# /metrics and adapters_resident on /healthz), a disaggregated-router
+# smoke leg
 # (scripts/router_smoke.py: 2-replica in-process router — byte
 # identity through page-granular KV migration, router_* metrics on the
 # /metrics scrape, session stickiness, replica-kill
@@ -104,6 +109,10 @@ echo "# serving-SLO smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
 slo_rc=$?
 [ $slo_rc -ne 0 ] && echo "# slo smoke FAILED (rc=$slo_rc)"
+echo "# batched-LoRA serving smoke leg"
+timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/lora_smoke.py
+lora_rc=$?
+[ $lora_rc -ne 0 ] && echo "# lora smoke FAILED (rc=$lora_rc)"
 echo "# disaggregated-router smoke leg"
 timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/router_smoke.py
 router_rc=$?
@@ -132,7 +141,7 @@ else
   ruff_rc=0
 fi
 echo "# bench regression gate"
-timeout -k 10 2100 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+timeout -k 10 2700 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -143,6 +152,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$pipeline_rc
 [ $rc -eq 0 ] && rc=$memory_rc
 [ $rc -eq 0 ] && rc=$slo_rc
+[ $rc -eq 0 ] && rc=$lora_rc
 [ $rc -eq 0 ] && rc=$router_rc
 [ $rc -eq 0 ] && rc=$overload_rc
 [ $rc -eq 0 ] && rc=$elastic_rc

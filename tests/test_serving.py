@@ -238,9 +238,9 @@ def test_health_payload_golden_shape(model_and_vars):
         server.complete(_prompt(30, 5), 4, timeout=120)
         payload = server.health()
     assert sorted(payload) == [
-        "active_requests", "active_slots", "adoptions_pending",
-        "closed", "degradation_level", "draining", "healthy",
-        "kv_pages_free", "kv_pages_total", "max_slots", "ok",
+        "active_requests", "active_slots", "adapters_resident",
+        "adoptions_pending", "closed", "degradation_level", "draining",
+        "healthy", "kv_pages_free", "kv_pages_total", "max_slots", "ok",
         "queue_depth", "queued_requests", "reason", "role",
     ]
     assert payload["ok"] is True and payload["role"] == "decode"
@@ -249,6 +249,9 @@ def test_health_payload_golden_shape(model_and_vars):
     # Paged server: the pool gauges are live numbers the router ranks on.
     assert payload["kv_pages_total"] == 2 * (64 // 8)
     assert 0 < payload["kv_pages_free"] <= payload["kv_pages_total"]
+    # No adapter pool on this server: the field exists (the router reads
+    # it unconditionally) but is None, like kv_pages_free on contiguous.
+    assert payload["adapters_resident"] is None
     with Server(model, variables, max_batch=1) as contig:
         p2 = contig.health()
     assert p2["role"] == "both" and p2["kv_pages_free"] is None
