@@ -37,6 +37,12 @@ TPU job fails in:
                       the replica at fleet index ``host`` at the
                       matching busy poll — watchdog-style death, the
                       drain-and-redistribute path under real load.
+                      Against a multi-process fleet replica
+                      (serving/fleet.py) the kill is a REAL ``SIGKILL``
+                      of the worker process: streams sever mid-socket
+                      with no goodbye, and recovery is the same
+                      redistribute-from-committed-prefix path the
+                      in-process simulation exercises.
 * ``replica_slow``  — serving chaos: the matching replica's serve loop
                       latches a slow-down window of ``secs`` seconds
                       (every loop iteration sleeps) once it is busy —

@@ -62,6 +62,7 @@ from ml_trainer_tpu.serving.loadgen import (
     schedule_from_trace,
     schedule_to_records,
 )
+from ml_trainer_tpu.serving.fleet import Fleet, RemoteServer
 from ml_trainer_tpu.serving.router import Router
 from ml_trainer_tpu.serving.transfer import (
     KVSlotExport,
@@ -76,6 +77,8 @@ __all__ = [
     "AdapterPoolExhausted",
     "UnknownAdapter",
     "Router",
+    "Fleet",
+    "RemoteServer",
     "Autoscaler",
     "AutoscalerConfig",
     "CircuitBreaker",

@@ -45,11 +45,14 @@ finish what's in flight, then ``close()``.
 
 from __future__ import annotations
 
+import base64
 import collections
 import json
+import os
+import queue
 import threading
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -66,6 +69,12 @@ from ml_trainer_tpu.serving.scheduler import (
 )
 from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
 from ml_trainer_tpu.utils.logging import get_logger
+
+# Stream sentinel kind a migration sink pushes between tokens — the
+# SAME literal serving/router.py's ``_MIGRATE`` uses (api.py must not
+# import router; the string is the wire contract).  The fleet stream
+# endpoint turns it into an ``{"m": <payload>}`` NDJSON line.
+_KV_MIGRATE = "__kv_migrate__"
 
 
 class TokenStream:
@@ -161,7 +170,8 @@ class Server:
                  slo: Optional[SloPolicy] = None,
                  slo_timelines: int = 64,
                  role: str = "both",
-                 adapters=None):
+                 adapters=None,
+                 prefill_chunk: int = 0):
         """``watchdog_timeout``: seconds the engine loop may go without a
         heartbeat WHILE work is pending before the watchdog declares it
         wedged — fails every in-flight/queued request with a structured
@@ -208,7 +218,13 @@ class Server:
         program, and ``load_adapter`` hot-loads new artifacts under
         live traffic with zero recompiles.  ``adapter=None`` traffic
         reads the all-zero trash slot and stays byte-identical to an
-        adapter-free server."""
+        adapter-free server.
+
+        ``prefill_chunk > 0`` (page multiple; needs paged KV) arms
+        CHUNKED PREFILL: a prompt longer than the chunk admits through
+        page-aligned continuation windows with decode ticks interleaved
+        between windows, so one long prompt cannot head-of-line-block
+        every short request's TTFT (docs/serving.md)."""
         if role not in ("prefill", "decode", "both"):
             raise ValueError(
                 f"role must be 'prefill', 'decode' or 'both', got {role!r}"
@@ -224,6 +240,7 @@ class Server:
             kv_page_size=kv_page_size, kv_pages=kv_pages,
             prefix_cache=prefix_cache, prefix_scope=prefix_scope,
             max_preemptions=max_preemptions, adapters=adapters,
+            prefill_chunk=prefill_chunk,
         )
         self.scheduler = TenantScheduler(
             max_batch, max_queue=max_queue, metrics=self.metrics,
@@ -266,6 +283,17 @@ class Server:
         self._evacuated = threading.Event()
         self._httpd = None
         self._http_thread = None
+        # Fleet identity (serving/fleet.py): process birth time for
+        # ``uptime_s``, and the transport this server is reached over —
+        # "inproc" (a Python object in the caller's process) until the
+        # fleet worker flips it to "http".
+        self._started_at = time.monotonic()
+        self.transport = "inproc"
+        # Wire-id -> Request registry for the fleet stream endpoints
+        # (/v1/stream, /v1/adopt): lets /v1/cancel reach a stream by the
+        # ROUTER's id, which is stable across processes.
+        self._wire_streams: Dict[int, Request] = {}
+        self._wire_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="serving-engine"
         )
@@ -415,14 +443,23 @@ class Server:
         self.slo.track(req)
         self._wake.set()
 
-    def adopt(self, req: Request, export) -> None:
+    def adopt(self, req: Request, export, resolver=None) -> None:
         """Accept a KV migration (thread-safe): ``req`` was prefilled on
         another replica and ``export`` is its slot's page payload
         (serving/transfer.py).  The loop thread imports it into a free
         slot bit-for-bit and decodes from there; if the pool cannot
         hold the chain the request falls back to requeue-and-reprefill
         from its committed tokens.  Raises ``EngineUnhealthy`` /
-        ``RuntimeError`` when this replica cannot take work."""
+        ``RuntimeError`` when this replica cannot take work.
+
+        ``resolver`` (fleet RPC, serving/fleet.py): a
+        ``callable(status, detail)`` the loop thread invokes with the
+        import outcome — ``"adopted"``, ``"corrupt"``, ``"no_memory"``,
+        ``"error"``, ``"expired"``, ``"cancelled"``, ``"draining"`` or
+        ``"unhealthy"``.  With a resolver installed, corrupt/no_memory
+        outcomes are REPORTED instead of locally requeued: the remote
+        router holds the payload and falls back to its next candidate
+        (the cross-process twin of the in-process fallback loop)."""
         if self._stopping:
             raise RuntimeError("server is closed")
         if not self.healthy:
@@ -438,7 +475,7 @@ class Server:
         # (the prefill replica forgot it at export).
         req.observer = self.slo.observe
         self.slo.track(req)
-        self._adoptions.append((req, export))
+        self._adoptions.append((req, export, resolver))
         self._wake.set()
 
     def complete(self, prompt, max_new_tokens: int,
@@ -556,8 +593,14 @@ class Server:
             "closed": self._stopping,
             "reason": self._unhealthy_reason,
             "role": self.role,
-            "active_requests": engine.active_count(),
-            "active_slots": engine.active_count(),
+            # Process identity (fleet debugging, serving/fleet.py): which
+            # OS process answered, how long it has been up, and whether
+            # it is reached in-process or over a socket.
+            "pid": os.getpid(),
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+            "transport": self.transport,
+            "active_requests": engine.active_count() + engine.chunking_count(),
+            "active_slots": engine.active_count() + engine.chunking_count(),
             "max_slots": engine.max_batch,
             "queued_requests": self.scheduler.queue_depth(),
             "queue_depth": self.scheduler.queue_depth(),
@@ -623,13 +666,30 @@ class Server:
                     sched.release(slot)
                 except ValueError:
                     pass
+        if release_slots:
+            for slot in engine.abort_chunked(msg):
+                try:
+                    sched.release(slot)
+                except ValueError:
+                    pass
+        else:
+            # Watchdog path: fail the chunk-in-progress STREAMS only —
+            # the loop thread may be wedged mid-window.
+            for st in list(engine._chunked.values()):
+                if st["req"].state == "active":
+                    st["req"].finish("error", msg)
         while self._adoptions:
             try:
-                req, _ = self._adoptions.popleft()
+                req, _, resolver = self._adoptions.popleft()
             except IndexError:
                 break
             if req.state == "active" or req.state == "queued":
                 req.finish("error", msg)
+            if resolver is not None:
+                # The remote router retries its other candidates with
+                # its own payload copy — "unhealthy" is its retryable
+                # fall-through signal.
+                resolver("unhealthy", msg)
         for req in sched.drain_pending():
             req.finish("error", msg)
         for req in engine.drain_preempted():
@@ -676,6 +736,7 @@ class Server:
             time.sleep(poll)
             busy = (
                 self.engine.active_count() > 0
+                or self.engine.chunking_count() > 0
                 or self.scheduler.queue_depth() > 0
                 or self._admitting_req is not None
                 or len(self._adoptions) > 0
@@ -719,7 +780,7 @@ class Server:
         progressed = False
         for _ in range(len(self._adoptions)):
             try:
-                req, export = self._adoptions.popleft()
+                req, export, resolver = self._adoptions.popleft()
             except IndexError:
                 break
             if req.expired():
@@ -728,18 +789,23 @@ class Server:
                     f"deadline ({req.deadline}s) passed awaiting adoption",
                 )
                 self.metrics.record_expiry()
+                if resolver is not None:
+                    self.slo.forget(req)
+                    resolver("expired", req.error)
                 progressed = True
                 continue
             if req.cancel_requested:
                 req.finish("error", "cancelled: hedge superseded")
                 self.metrics.record_cancellation()
+                if resolver is not None:
+                    resolver("cancelled", req.error)
                 progressed = True
                 continue
             slot = sched.acquire_direct(req)
             if slot is None:
                 # No free slot right now: park it at the head so the
                 # next free slot goes to the oldest adoption.
-                self._adoptions.appendleft((req, export))
+                self._adoptions.appendleft((req, export, resolver))
                 break
             # Tracked like a prefill admission: a crash mid-import is
             # visible to the watchdog/error handler (the request is not
@@ -755,14 +821,20 @@ class Server:
                 # verifies at deserialization, so this is the last
                 # line): refuse the pages, fall back to the ordinary
                 # requeue-and-reprefill resume — never adopt garbage,
-                # never poison the loop.
+                # never poison the loop.  With a resolver (fleet RPC)
+                # the corrupt verdict is REPORTED instead: the remote
+                # router owns the payload and its fallback candidates.
                 self._admitting_req = None
                 sched.release(slot)
                 req.mark("adopt_corrupt", error=str(e))
                 self._log.error(
                     "serving_adopt_corrupt", request=req.id, error=str(e)
                 )
-                sched.requeue(req)
+                if resolver is not None:
+                    self.slo.forget(req)
+                    resolver("corrupt", str(e))
+                else:
+                    sched.requeue(req)
                 progressed = True
                 continue
             self._admitting_req = None
@@ -771,14 +843,22 @@ class Server:
                 req.mark("adopt_no_memory", kv_pages_free=(
                     engine.pool.free_count() if engine.paged else None
                 ))
-                sched.requeue(req)
+                if resolver is not None:
+                    self.slo.forget(req)
+                    resolver("no_memory", "kv pool cannot hold the chain")
+                else:
+                    sched.requeue(req)
             elif status == "error":
                 # The import finished the request with a structured
                 # error (e.g. an unregistered adapter on this replica);
                 # nothing bound — just hand the slot back.
                 sched.release(slot)
+                if resolver is not None:
+                    resolver("error", req.error)
             else:
                 req.mark("adopted", slot=slot)
+                if resolver is not None:
+                    resolver("adopted", None)
             progressed = True
         return progressed
 
@@ -822,6 +902,7 @@ class Server:
             return
         busy = (
             self.engine.active_count() > 0
+            or self.engine.chunking_count() > 0
             or self.scheduler.queue_depth() > 0
             or len(self._adoptions) > 0
         )
@@ -870,12 +951,35 @@ class Server:
                     f"replica draining for role reassignment; evacuation "
                     f"sink failed: {type(e).__name__}: {e}",
                 )
+        # Chunk-in-progress prompts have no committed tokens yet: fail
+        # them with the retryable ``draining`` error (the router
+        # resubmits from scratch) instead of exporting half-written
+        # pages.
+        for st in engine._chunked.values():
+            self.slo.forget(st["req"])
+        for slot in engine.abort_chunked(
+            "replica draining for role reassignment: request "
+            "redistributed"
+        ):
+            try:
+                sched.release(slot)
+            except ValueError:
+                pass
         while self._adoptions:
             try:
-                req, export = self._adoptions.popleft()
+                req, export, resolver = self._adoptions.popleft()
             except IndexError:
                 break
             self.slo.forget(req)
+            if resolver is not None:
+                # A fleet-RPC adoption still pending at evacuation: the
+                # remote router holds the payload — report "draining"
+                # and let it fall to its next candidate.
+                resolver(
+                    "draining",
+                    "replica draining for role reassignment",
+                )
+                continue
             try:
                 sink(req, export)
             except Exception as e:  # noqa: BLE001
@@ -930,6 +1034,18 @@ class Server:
                         sched.release(slot)
                     elif status == "active" and req.migration_sink is not None:
                         self._export_for_migration(req, slot)
+                    # "chunking" holds its slot: advance_chunks below
+                    # runs one window per loop iteration.
+                # One chunked-prefill window per slot per iteration,
+                # AFTER admissions — short requests admit (and decode,
+                # below) between a long prompt's windows instead of
+                # waiting out its whole prefill.
+                for slot, req, status in engine.advance_chunks():
+                    progressed = True
+                    if status == "finished":
+                        sched.release(slot)
+                    elif status == "active" and req.migration_sink is not None:
+                        self._export_for_migration(req, slot)
                 if engine.active_count():
                     self._maybe_slow()
                     for slot in engine.step():
@@ -970,10 +1086,23 @@ class Server:
                         sched.release(slot)
                     except ValueError:
                         pass
+                for slot in engine.abort_chunked(err):
+                    try:
+                        sched.release(slot)
+                    except ValueError:
+                        pass
                 for req in engine.drain_preempted():
                     req.finish("error", err)
 
     # -- HTTP front end --------------------------------------------------
+
+    def _register_wire(self, wire_id, req: Request) -> None:
+        with self._wire_lock:
+            self._wire_streams[int(wire_id)] = req
+
+    def _forget_wire(self, wire_id) -> None:
+        with self._wire_lock:
+            self._wire_streams.pop(int(wire_id), None)
 
     def serve_http(self, host: str = "127.0.0.1", port: int = 0):
         """Start the stdlib HTTP front end (daemon thread); returns the
@@ -1009,12 +1138,290 @@ class Server:
                 self.end_headers()
                 self.wfile.write(body)
 
+            # -- fleet NDJSON streaming (serving/fleet.py) ------------
+            # HTTP/1.0 close-delimited bodies: no Content-Length, the
+            # connection closing marks the end of the stream — the
+            # stdlib client reads line-by-line until EOF.
+
+            def _ndjson_start(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.end_headers()
+
+            def _ndjson(self, obj) -> bool:
+                try:
+                    self.wfile.write(json.dumps(obj).encode() + b"\n")
+                    self.wfile.flush()
+                    return True
+                except (ConnectionError, OSError):
+                    return False
+
+            def _stream_tokens(self, req):
+                """Pump ``req``'s stream to the socket as NDJSON lines
+                until terminal: ``{"t": token}`` per token, ``{"m":
+                b64(payload)}`` + ``{"done": {"state": "migrated"}}``
+                when a migration sink fires, else a final ``{"done":
+                {...}}``.  A vanished client cancels server-side."""
+                from ml_trainer_tpu.serving import transfer
+
+                while True:
+                    try:
+                        item = req._stream.get(timeout=600.0)
+                    except queue.Empty:
+                        server.cancel(req)
+                        self._ndjson({"done": {
+                            "state": "error",
+                            "error": "serving engine unhealthy: stream "
+                                     "stalled past 600s",
+                        }})
+                        return
+                    if item == _DONE:
+                        done = {"state": req.state}
+                        if req.error is not None:
+                            done["error"] = req.error
+                        if req.retry_after is not None:
+                            done["retry_after"] = req.retry_after
+                        self._ndjson({"done": done})
+                        return
+                    if (isinstance(item, tuple) and len(item) == 2
+                            and item[0] == _KV_MIGRATE):
+                        payload = transfer.to_bytes(item[1])
+                        if self._ndjson(
+                            {"m": base64.b64encode(payload).decode()}
+                        ):
+                            self._ndjson({"done": {"state": "migrated"}})
+                        return
+                    if not self._ndjson({"t": int(item)}):
+                        server.cancel(req)
+                        return
+
+            def _post_stream(self):
+                """POST /v1/stream: the fleet's cross-process
+                ``submit_request``.  The FIRST NDJSON line is the
+                synchronous admission verdict (``accepted`` or a mapped
+                structured refusal), then tokens stream."""
+                from ml_trainer_tpu.serving.transfer import (
+                    request_from_wire,
+                )
+
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    req = request_from_wire(body)
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                if body.get("migrate"):
+                    # Prefill-and-export: the sink pushes the export
+                    # into THIS stream, which ships it as an "m" line —
+                    # the remote router adopts it elsewhere.
+                    req.migration_sink = (
+                        lambda r, exp: r._stream.put((_KV_MIGRATE, exp))
+                    )
+                wire_id = body.get("id", req.id)
+                self._ndjson_start()
+                try:
+                    server.submit_request(req)
+                except OverloadShed as e:
+                    self._ndjson({"status": "shed", "error": str(e),
+                                  "retry_after": e.retry_after})
+                    return
+                except AdmissionError as e:
+                    self._ndjson({"status": "draining", "error": str(e)})
+                    return
+                except EngineUnhealthy as e:
+                    self._ndjson({"status": "unhealthy",
+                                  "error": str(e)})
+                    return
+                except RuntimeError as e:
+                    self._ndjson({"status": "closed", "error": str(e)})
+                    return
+                server._register_wire(wire_id, req)
+                try:
+                    self._ndjson({"status": "accepted"})
+                    self._stream_tokens(req)
+                finally:
+                    server._forget_wire(wire_id)
+
+            def _post_adopt(self):
+                """POST /v1/adopt: the fleet's cross-process ``adopt``.
+                The serialized ``KVSlotExport`` rides as the raw body
+                (request identity in the ``X-Request-Meta`` header) and
+                is CRC-VERIFIED HERE, at the receiving process; the
+                first NDJSON line is the structured import verdict the
+                remote router maps back into its fallback-candidate
+                loop."""
+                from ml_trainer_tpu.serving import transfer
+
+                try:
+                    meta = json.loads(
+                        self.headers.get("X-Request-Meta", "{}")
+                    )
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = self.rfile.read(n)
+                    req = transfer.request_from_wire(meta)
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                self._ndjson_start()
+                try:
+                    export = transfer.from_bytes(payload, verify=True)
+                except transfer.MigrationCorrupt as e:
+                    self._ndjson({"status": "corrupt", "error": str(e)})
+                    return
+                resolved: queue.Queue = queue.Queue()
+                try:
+                    server.adopt(
+                        req, export,
+                        resolver=lambda s, d: resolved.put((s, d)),
+                    )
+                except AdmissionError as e:
+                    self._ndjson({"status": "draining", "error": str(e)})
+                    return
+                except EngineUnhealthy as e:
+                    self._ndjson({"status": "unhealthy",
+                                  "error": str(e)})
+                    return
+                except RuntimeError as e:
+                    self._ndjson({"status": "closed", "error": str(e)})
+                    return
+                wire_id = meta.get("id", req.id)
+                server._register_wire(wire_id, req)
+                try:
+                    try:
+                        status, detail = resolved.get(timeout=120.0)
+                    except queue.Empty:
+                        server.cancel(req)
+                        self._ndjson({"status": "error",
+                                      "error": "adoption timed out"})
+                        return
+                    if status != "adopted":
+                        line = {"status": status}
+                        if detail:
+                            line["error"] = detail
+                        self._ndjson(line)
+                        return
+                    self._ndjson({"status": "adopted"})
+                    self._stream_tokens(req)
+                finally:
+                    server._forget_wire(wire_id)
+
+            def _post_cancel(self):
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    wire_id = int(body["id"])
+                except (KeyError, TypeError, ValueError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return
+                req = server._wire_streams.get(wire_id)
+                if req is not None:
+                    server.cancel(req)
+                self._send(200, {"ok": req is not None})
+
+            def _post_admin(self) -> bool:
+                """Fleet control plane; True when the path matched."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except (TypeError, ValueError, json.JSONDecodeError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                    return True
+                path = self.path
+                try:
+                    if path == "/admin/role":
+                        role = body["role"]
+                        if role not in ("prefill", "decode", "both"):
+                            raise ValueError(f"bad role {role!r}")
+                        server.role = role
+                        self._send(200, {"ok": True, "role": role})
+                    elif path == "/admin/replica_index":
+                        server.replica_index = int(body["index"])
+                        self._send(200, {"ok": True})
+                    elif path == "/admin/degradation":
+                        cfg = body.get("config")
+                        server.set_degradation(
+                            int(body.get("level", 0)),
+                            DegradationConfig(**cfg) if cfg else None,
+                        )
+                        self._send(200, {"ok": True})
+                    elif path == "/admin/shed_queued":
+                        shed = server.shed_queued(
+                            int(body.get("below_priority", 0)),
+                            float(body.get("retry_after", 1.0)),
+                            cause=str(body.get("cause", "overload")),
+                        )
+                        self._send(200, {"shed": shed})
+                    elif path == "/admin/fail":
+                        server._mark_unhealthy(
+                            str(body.get("reason", "failed by admin"))
+                        )
+                        self._send(200, {"ok": True})
+                    elif path == "/admin/evacuate":
+                        # Stream-sink evacuation: each active slot's
+                        # export rides its OWN open stream as an "m"
+                        # line — the remote router's pumps adopt them.
+                        ok = server.evacuate(
+                            lambda req, exp: req._stream.put(
+                                (_KV_MIGRATE, exp)
+                            ),
+                            timeout=float(body.get("timeout", 30.0)),
+                        )
+                        self._send(200, {"ok": ok})
+                    elif path == "/admin/shutdown":
+                        self._send(200, {"ok": True})
+                        if getattr(server, "transport", "") == "http":
+                            # A fleet worker process: exit outright
+                            # once the response is on the wire.
+                            def _die():
+                                time.sleep(0.25)
+                                os._exit(0)
+
+                            threading.Thread(
+                                target=_die, daemon=True
+                            ).start()
+                        threading.Thread(
+                            target=server.close, daemon=True
+                        ).start()
+                    else:
+                        return False
+                except (KeyError, TypeError, ValueError) as e:
+                    self._send(400, {"error": f"{type(e).__name__}: {e}"})
+                return True
+
             def do_GET(self):
                 if self.path == "/healthz":
                     payload = server.health()
                     # 503 while wedged/draining so load balancers stop
                     # routing here; the payload says why.
                     self._send(200 if payload["ok"] else 503, payload)
+                elif self.path == "/v1/spec":
+                    # Fleet geometry handshake (serving/fleet.py): what
+                    # a RemoteServer proxy needs to stand in for the
+                    # engine object, plus the process compile counter
+                    # (the cross-process zero-recompile pin).
+                    from ml_trainer_tpu.telemetry import compile_watch
+
+                    eng = server.engine
+                    self._send(200, {
+                        "max_len": eng.max_len,
+                        "vocab_size": eng.vocab_size,
+                        "spec_k": eng.spec_k,
+                        "kv_page_size": eng.kv_page_size,
+                        "paged": eng.paged,
+                        "prefill_chunk": eng.prefill_chunk,
+                        "max_batch": eng.max_batch,
+                        "max_queue": server.scheduler.max_queue,
+                        "role": server.role,
+                        "pid": os.getpid(),
+                        "compiles": (
+                            compile_watch.compile_count()
+                            if compile_watch.installed() else None
+                        ),
+                    })
                 elif self.path == "/metrics":
                     # Prometheus text exposition of the WHOLE process
                     # registry (trainer gauges included when co-resident),
@@ -1059,6 +1466,17 @@ class Server:
                         self._send(
                             400, {"error": f"{type(e).__name__}: {e}"}
                         )
+                    return
+                if self.path == "/v1/stream":
+                    self._post_stream()
+                    return
+                if self.path == "/v1/adopt":
+                    self._post_adopt()
+                    return
+                if self.path == "/v1/cancel":
+                    self._post_cancel()
+                    return
+                if self.path.startswith("/admin/") and self._post_admin():
                     return
                 if self.path != "/v1/generate":
                     self._send(404, {"error": "not found"})

@@ -146,7 +146,8 @@ class SlotDecodeEngine:
                  prefix_cache: bool = True,
                  prefix_scope: str = "tenant",
                  max_preemptions: int = 8,
-                 adapters=None):
+                 adapters=None,
+                 prefill_chunk: int = 0):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if not getattr(model, "max_len", 0):
@@ -205,6 +206,37 @@ class SlotDecodeEngine:
                 raise ValueError("kv_pages needs kv_page_size > 0")
             self.kv_pages = 0
             self._key_model = model
+
+        # -- chunked prefill (opt-in; page-aligned windows) --------------
+        # Long prompts prefill in ``prefill_chunk``-token windows through
+        # the paged continuation program, with decode ticks interleaved
+        # between windows (serving/api.py advances one window per loop
+        # iteration) — one long prompt can no longer head-of-line-block
+        # every short request's TTFT.
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk:
+            if not self.paged:
+                raise ValueError(
+                    "prefill_chunk needs paged KV (kv_page_size > 0): "
+                    "chunk windows are continuation-window prefills at "
+                    "the slot's page-aligned offset"
+                )
+            if self.prefill_chunk % self.kv_page_size:
+                raise ValueError(
+                    f"prefill_chunk ({prefill_chunk}) must be a multiple "
+                    f"of kv_page_size ({kv_page_size}): every window "
+                    "boundary must land on a page boundary"
+                )
+            if spec_k:
+                raise ValueError(
+                    "prefill_chunk with spec_k > 0 is not supported yet: "
+                    "the draft cache has no continuation-window prefill "
+                    "(serve chunked prefill with spec_k=0)"
+                )
+        # Chunk-in-progress slots: slot -> dispatch state.  These hold
+        # their slot (free_capacity counts them) but are not yet in
+        # ``_active`` — decode steps skip them until the final window.
+        self._chunked: Dict[int, dict] = {}
 
         # -- batched LoRA adapter pool (opt-in; docs/serving.md) --------
         # The model clones with ``lora_slots > 0``: every targeted Dense
@@ -919,18 +951,24 @@ class SlotDecodeEngine:
     # -- serving ---------------------------------------------------------
 
     def free_capacity(self) -> int:
-        return self.max_batch - len(self._active)
+        return self.max_batch - len(self._active) - len(self._chunked)
 
     def active_count(self) -> int:
         return len(self._active)
 
+    def chunking_count(self) -> int:
+        return len(self._chunked)
+
     def admit(self, req: Request, slot: int) -> str:
         """Prefill ``req`` into ``slot`` and emit its first token.
         Returns ``"active"`` (decoding), ``"finished"`` (EOS on token 0
-        or a one-token budget — the caller recycles the slot), or
+        or a one-token budget — the caller recycles the slot),
         ``"no_memory"`` (paged mode: the pool cannot hold the prompt
         right now — the caller re-queues the request and retries once
-        running requests free pages)."""
+        running requests free pages), or ``"chunking"`` (chunked
+        prefill engaged: the slot is held and ``advance_chunks`` runs
+        one window per serving-loop iteration until the request
+        activates)."""
         if slot in self._active:
             raise ValueError(f"slot {slot} is already occupied")
         if req.adapter and self.adapters is None:
@@ -1041,6 +1079,9 @@ class SlotDecodeEngine:
 
         req.slot = slot
         req.state = "active"
+        if self.prefill_chunk and (p - c) > self.prefill_chunk:
+            return self._admit_chunked(req, slot, prompt, c, key,
+                                       done_tokens)
         req.mark(
             "prefill_start", slot=slot,
             kind="continuation" if (self.paged and c > 0) else "full",
@@ -1175,6 +1216,168 @@ class SlotDecodeEngine:
                 np.int32(done_tokens), np.int32(slot), *extra,
             )
         return tok0
+
+    # -- chunked prefill (prefill_chunk mode) -----------------------------
+
+    def _admit_chunked(self, req, slot, prompt, c, key, done_tokens):
+        """Admit a long prompt through page-aligned prefill windows:
+        dispatch the first window now (async — nothing blocks) and park
+        the slot in ``_chunked``; the serving loop advances one window
+        per iteration via ``advance_chunks``, decoding between windows.
+        Byte identity holds because every window is the SAME paged
+        continuation program a prefix-cache hit runs (at the slot's
+        dynamic offset), and the sampling fold-in counter is
+        non-consuming — intermediate windows' discarded samples cannot
+        perturb the final window's draw."""
+        p = prompt.shape[0]
+        req.mark(
+            "prefill_start", slot=slot, kind="chunked",
+            prefix_hit_tokens=c, resumed_tokens=done_tokens,
+            window=self.prefill_chunk,
+        )
+        self.metrics.record_chunked_admission()
+        self._chunked[slot] = {
+            "req": req, "prompt": prompt, "p": p, "key": key,
+            "done_tokens": done_tokens, "next": c, "secs": 0.0,
+        }
+        self._dispatch_chunk(slot)
+        return "chunking"
+
+    def _dispatch_chunk(self, slot: int):
+        """Run ONE prefill window for a chunk-in-progress slot.  The
+        window start is always page-aligned (prefix hits are
+        block-granular and ``prefill_chunk`` is a page multiple).
+        Non-final windows return None WITHOUT blocking on the device —
+        the interleaving win; the final window blocks and returns the
+        request's first sampled token."""
+        st = self._chunked[slot]
+        req, prompt, p = st["req"], st["prompt"], st["p"]
+        start = st["next"]
+        w = min(self.prefill_chunk, p - start)
+        final = start + w >= p
+        t0 = time.perf_counter()
+        bucket = min(
+            max(self._MIN_SUFFIX_BUCKET, 1 << (w - 1).bit_length()),
+            self.max_len,
+        )
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :w] = prompt[start: start + w]
+        run = self._program(
+            ("serve_prefill_paged", self._key_model, bucket),
+            lambda: self._build_prefill_paged(bucket),
+        )
+        extra = (
+            (self._lora_vars(self._adapter_rows[slot: slot + 1]),)
+            if self._lora_on else ()
+        )
+        with span("serve_prefill_chunk", prompt_len=p, start=start,
+                  window=w, bucket=bucket, slot=slot, request=req.id,
+                  tenant=req.tenant):
+            self.cache, self.tok, tok0 = run(
+                self.cache, self.tok, self.params, padded, np.int32(w),
+                np.int32(start), jnp.asarray(self._page_row(slot)),
+                jnp.asarray(req.temperature, jnp.float32), st["key"],
+                np.int32(st["done_tokens"]), np.int32(slot), *extra,
+            )
+        st["next"] = start + w
+        req.prefill_chunks += 1
+        self.metrics.record_prefill_chunk()
+        if not final:
+            st["secs"] += time.perf_counter() - t0
+            req.mark("prefill_chunk", start=start, window=w)
+            return None
+        tok0 = np.asarray(tok0)  # blocks until the last window lands
+        st["secs"] += time.perf_counter() - t0
+        return tok0
+
+    def advance_chunks(self) -> List[tuple]:
+        """Advance every chunk-in-progress slot by ONE window (the
+        serving loop calls this once per iteration, AFTER admissions and
+        before decode — short requests admit and decode between a long
+        prompt's windows).  Returns ``(slot, req, status)`` tuples:
+        ``"chunking"`` (more windows pending), ``"active"`` (final
+        window landed, request now decoding), or ``"finished"``
+        (completed/cancelled/expired on its first token — the caller
+        recycles the slot)."""
+        out: List[tuple] = []
+        now = time.monotonic()
+        for slot in sorted(self._chunked):
+            st = self._chunked.get(slot)
+            if st is None:
+                continue
+            req = st["req"]
+            if req.cancel_requested:
+                del self._chunked[slot]
+                req.finish("error", "cancelled: hedge superseded")
+                self.metrics.record_cancellation()
+                self._release_slot_pages(slot, None, donate=False)
+                out.append((slot, req, "finished"))
+                continue
+            if req.expired(now):
+                del self._chunked[slot]
+                req.finish("expired")
+                self.metrics.record_expiry()
+                self._release_slot_pages(slot, None, donate=False)
+                out.append((slot, req, "finished"))
+                continue
+            tok0 = self._dispatch_chunk(slot)
+            if tok0 is None:
+                out.append((slot, req, "chunking"))
+                continue
+            out.append((slot, req, self._finalize_chunked(slot, req, tok0)))
+        return out
+
+    def _finalize_chunked(self, slot: int, req: Request, tok0) -> str:
+        """The admit tail for a chunked admission: the last window
+        landed, so the slot activates exactly as an unchunked admission
+        would — position, sampler state, prefix registration, first
+        token, TTFT."""
+        st = self._chunked.pop(slot)
+        prompt, p = st["prompt"], st["p"]
+        done_tokens = st["done_tokens"]
+        self._pos[slot] = p
+        req.prefill_secs += st["secs"]
+        req.mark("prefill_done", ms=round(st["secs"] * 1e3, 3),
+                 chunks=req.prefill_chunks)
+        self.metrics.record_prefill(st["secs"])
+        self._temps[slot] = req.temperature
+        self._rngs[slot] = st["key"]
+        self._steps[slot] = done_tokens + 1
+        if self._prefix is not None:
+            self._prefix.insert(
+                prompt,
+                self.pool.slot_pages[slot][: p // self.kv_page_size],
+                namespace=self._prefix_ns(req),
+            )
+        self._push_kv_metrics()
+        token = int(tok0.reshape(-1)[0])
+        req.push_token(token)
+        if done_tokens == 0:
+            self.metrics.record_ttft(
+                time.monotonic() - req.submitted_at, tenant=req.tenant
+            )
+            if req.first_admitted_at is not None:
+                self.metrics.record_queue_wait(
+                    req.first_admitted_at - req.submitted_at,
+                    tenant=req.tenant,
+                )
+        self._active[slot] = req
+        if self._finished(req, token):
+            return "finished"
+        return "active"
+
+    def abort_chunked(self, msg: str) -> List[int]:
+        """Fail every chunk-in-progress request with a structured error
+        (teardown/evacuation: their page chains are only partially
+        written, so pages release WITHOUT prefix donation).  Returns the
+        freed slots for the caller to recycle."""
+        freed: List[int] = []
+        for slot in list(self._chunked):
+            st = self._chunked.pop(slot)
+            st["req"].finish("error", msg)
+            self._release_slot_pages(slot, None, donate=False)
+            freed.append(slot)
+        return freed
 
     def _admit_draft(self, prompt, slot, key, temperature):
         """Prefill the draft model's own (contiguous) slot cache with

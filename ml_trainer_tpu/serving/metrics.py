@@ -133,6 +133,10 @@ class ServingMetrics:
         self.prefix_lookup_tokens = 0
         self.preemptions_total = 0
         self.admissions_blocked = 0
+        # Chunked prefill (engine prefill_chunk mode): admissions that
+        # took the chunked path, and total prefill windows dispatched.
+        self.chunked_admissions_total = 0
+        self.prefill_chunks_total = 0
         # Batched LoRA adapter pool (serving/adapter_pool.py): slot
         # occupancy (free/used/total EXCLUDING the trash slot), resident
         # count, hit/load/eviction counters, and the device bytes one
@@ -302,6 +306,17 @@ class ServingMetrics:
         with self._lock:
             self.admissions_blocked += 1
 
+    def record_chunked_admission(self) -> None:
+        """One long prompt admitted via the chunked-prefill path."""
+        with self._lock:
+            self.chunked_admissions_total += 1
+
+    def record_prefill_chunk(self) -> None:
+        """One chunked-prefill window dispatched (decode ticks run
+        between windows — the interleaving behind unblocked TTFT)."""
+        with self._lock:
+            self.prefill_chunks_total += 1
+
     def record_kv(self, free: int, used: int, total: int,
                   prefix_nodes: int,
                   bytes_per_page: Optional[int] = None) -> None:
@@ -445,6 +460,8 @@ class ServingMetrics:
                 ) if self.prefix_lookup_tokens else 0.0,
                 "preemptions_total": self.preemptions_total,
                 "admissions_blocked": self.admissions_blocked,
+                "chunked_admissions_total": self.chunked_admissions_total,
+                "prefill_chunks_total": self.prefill_chunks_total,
                 "adapter_slots_free": self.adapter_slots_free,
                 "adapter_slots_used": self.adapter_slots_used,
                 "adapter_slots_total": self.adapter_slots_total,

@@ -559,12 +559,18 @@ class Router:
         )
 
     def kill_replica(self, name: str) -> None:
-        """Simulate a replica death (tests/chaos): the replica fails its
+        """Kill a replica (tests/chaos): the replica fails its
         in-flight work with structured errors — which the router
-        redistributes — and leaves the placement pool."""
+        redistributes — and leaves the placement pool.  Against a fleet
+        process (serving/fleet.py, ``kill_process``) this is a REAL
+        ``SIGKILL`` — no goodbye, streams sever mid-flight; in-process
+        replicas are marked unhealthy instead (the simulation)."""
         rep = self._replicas[name]
         rep.healthy = False
         self.metrics.set_replica_health(name, False)
+        kill = getattr(rep.server, "kill_process", None)
+        if kill is not None:
+            kill()
         rep.server._mark_unhealthy(f"replica '{name}' killed")
 
     # -- fleet management (serving/autoscaler.py) -------------------------
@@ -585,6 +591,11 @@ class Router:
                 "a colocated fleet only takes role='both' replicas"
             )
         self._validate_geometry(name, server)
+        if url is None:
+            # A fleet RemoteServer (serving/fleet.py) carries its own
+            # base URL — the autoscaler's factory path adds replicas
+            # without threading one through.
+            url = getattr(server, "url", None)
         rep = Replica(name, server, url, breaker=self._new_breaker())
         server.set_degradation(self.ladder.level, self.ladder.config)
         rep.last_health = rep.fetch_health()
@@ -719,8 +730,17 @@ class Router:
                 continue
             payload = transfer.to_bytes(export)
             try:
-                incoming = transfer.from_bytes(payload)
-                rep.server.adopt(req, incoming)
+                adopt_payload = getattr(
+                    rep.server, "adopt_payload", None
+                )
+                if adopt_payload is not None:
+                    # Fleet RPC (serving/fleet.py): ship the bytes —
+                    # CRC verification happens in the RECEIVING
+                    # process, structured verdicts map back here.
+                    adopt_payload(req, payload)
+                else:
+                    incoming = transfer.from_bytes(payload)
+                    rep.server.adopt(req, incoming)
             except MigrationCorrupt:
                 self.metrics.record_corrupt_migration()
                 continue
@@ -1285,8 +1305,19 @@ class Router:
                     flipped[len(flipped) // 2] ^= 0x40
                     payload = bytes(flipped)
             try:
-                incoming = transfer.from_bytes(payload)
-                rep.server.adopt(shadow, incoming)
+                adopt_payload = getattr(
+                    rep.server, "adopt_payload", None
+                )
+                if adopt_payload is not None:
+                    # Fleet RPC (serving/fleet.py): POST the bytes to
+                    # the replica PROCESS — the CRC gate runs at the
+                    # receiving end (a bit flipped on this socket hop
+                    # is caught there), and the structured verdict
+                    # maps onto the same except arms below.
+                    adopt_payload(shadow, payload)
+                else:
+                    incoming = transfer.from_bytes(payload)
+                    rep.server.adopt(shadow, incoming)
             except MigrationCorrupt as e:
                 self.metrics.record_corrupt_migration()
                 self._log.error(

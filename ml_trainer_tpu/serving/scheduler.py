@@ -144,6 +144,9 @@ class Request:
     seq: Optional[int] = None
     preemptions: int = 0
     prefix_hit_tokens: int = 0
+    # Chunked prefill (engine prefill_chunk mode): how many prefill
+    # windows this request's prompt was split into (0 = unchunked).
+    prefill_chunks: int = 0
     # Admission returned "no_memory" and the serve loop is retrying:
     # retries skip prefix-cache stat/LRU accounting so a blocked request
     # can't inflate hit rates or re-heat its own prefix pages while the

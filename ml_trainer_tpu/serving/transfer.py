@@ -47,6 +47,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
+import time
 import zlib
 from typing import List, Optional
 
@@ -393,6 +394,64 @@ def from_bytes(payload: bytes, verify: bool = True) -> KVSlotExport:
     if verify:
         exp.verify()
     return exp
+
+
+def request_wire_meta(req) -> dict:
+    """JSON-safe request identity for the fleet wire (serving/fleet.py):
+    everything a remote replica needs to rebuild an EQUIVALENT
+    :class:`~ml_trainer_tpu.serving.scheduler.Request` — prompt,
+    sampling state (rng normalized to ``null | int | [u32, u32]``),
+    committed tokens, and the deadline converted to REMAINING seconds
+    (monotonic clocks do not cross process boundaries)."""
+    rng = req.rng
+    if rng is not None and not isinstance(rng, (int, np.integer)):
+        rng = [int(x) for x in
+               np.asarray(rng, np.uint32).reshape(-1)]
+    elif rng is not None:
+        rng = int(rng)
+    deadline = None
+    if req.deadline is not None:
+        deadline = max(req.deadline_at - time.monotonic(), 0.001)
+    return {
+        "id": int(req.id),
+        "prompt": [int(t) for t in np.asarray(req.prompt).reshape(-1)],
+        "max_new_tokens": int(req.max_new_tokens),
+        "temperature": float(req.temperature),
+        "rng": rng,
+        "eos_token_id": (
+            int(req.eos_token_id) if req.eos_token_id is not None else None
+        ),
+        "deadline": deadline,
+        "tenant": req.tenant,
+        "priority": int(req.priority),
+        "adapter": req.adapter,
+        "tokens": [int(t) for t in req.tokens],
+    }
+
+
+def request_from_wire(meta: dict):
+    """Rebuild a request from :func:`request_wire_meta` output.  The
+    fresh ``submitted_at`` makes the wire's remaining-seconds deadline
+    correct on the receiving process's own monotonic clock; committed
+    tokens ride as the resumable prefix, exactly like a router shadow."""
+    from ml_trainer_tpu.serving.scheduler import Request
+
+    rng = meta.get("rng")
+    if isinstance(rng, (list, tuple)):
+        rng = np.asarray(rng, np.uint32)
+    req = Request(
+        prompt=np.asarray(meta["prompt"], np.int32),
+        max_new_tokens=int(meta["max_new_tokens"]),
+        temperature=float(meta.get("temperature", 0.0)),
+        rng=rng,
+        eos_token_id=meta.get("eos_token_id"),
+        deadline=meta.get("deadline"),
+        tenant=meta.get("tenant", "default"),
+        priority=int(meta.get("priority", 0)),
+        adapter=meta.get("adapter"),
+    )
+    req.tokens = [int(t) for t in meta.get("tokens", [])]
+    return req
 
 
 def _from_bytes_unchecked(payload: bytes) -> KVSlotExport:

@@ -98,6 +98,91 @@ sys.path.insert(0, REPO)
 
 BASELINE_FILE = os.path.join(REPO, ".bench_gate_baseline.json")
 
+# Every gate leg --changed-only can select.  "parity" is the headline
+# train-step gate; the rest match their gate_<name> function.
+ALL_LEGS = frozenset({
+    "parity", "serve", "mixed", "pipeline", "slo", "disagg", "lora",
+    "overload", "goodput", "elastic", "lint", "fleet",
+})
+
+# Committed artifacts map to exactly the leg that ratchets against
+# them: regenerating an artifact must re-run its gate.
+_ARTIFACT_LEGS = {
+    "serving_replay_cpu.json": "serve",
+    "mixed_precision_cpu.json": "mixed",
+    "pipeline_schedules_cpu.json": "pipeline",
+    "serving_slo_cpu.json": "slo",
+    "serving_disagg_cpu.json": "disagg",
+    "serving_lora_cpu.json": "lora",
+    "serving_chaos_cpu.json": "overload",
+    "serving_fleet_cpu.json": "fleet",
+    "memory_goodput_cpu.json": "goodput",
+    "elastic_chaos_cpu.json": "elastic",
+    "graft_lint_baseline.json": "lint",
+}
+
+
+def changed_files(ref: str = "origin/main",
+                  repo: str = REPO):
+    """Repo-relative paths changed vs ``ref`` (committed diff plus the
+    working tree), or None when git cannot answer — the caller must
+    treat None as "run everything"."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", ref, "--"],
+            cwd=repo, capture_output=True, text=True, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    return [line.strip() for line in out.stdout.splitlines()
+            if line.strip()]
+
+
+def legs_for_changes(files) -> set:
+    """Pure mapping: changed paths -> the gate legs that must run.
+
+    Conservative by construction — anything unrecognized selects EVERY
+    leg, and a ``ml_trainer_tpu/serving/`` change selects every leg
+    (the serving stack underpins most of them and shares the engine the
+    parity gate times).  Docs/tests/smoke-script-only diffs select a
+    strict subset (tier-1 and the smokes still cover them in the
+    fastlane).  ``None`` (git unavailable) selects everything."""
+    if files is None:
+        return set(ALL_LEGS)
+    legs: set = set()
+    for path in files:
+        base = os.path.basename(path)
+        if path.startswith("docs/") and base in _ARTIFACT_LEGS:
+            legs.add(_ARTIFACT_LEGS[base])
+            continue
+        if re.match(r"BENCH_r\d+\.json$", base):
+            legs.add("parity")
+            continue
+        # Docs, tests, and the smoke scripts ride tier-1/smoke legs —
+        # they cannot regress a bench number.
+        if path.endswith((".md", ".rst", ".txt")) or \
+                path.startswith(("docs/", "tests/")) or \
+                base in (".gitignore", "LICENSE") or \
+                (path.startswith("scripts/")
+                 and base.endswith("_smoke.py")):
+            continue
+        if path.startswith("ml_trainer_tpu/serving/"):
+            return set(ALL_LEGS)
+        if path.startswith("ml_trainer_tpu/resilience/"):
+            legs.update({"elastic", "overload", "fleet"})
+            continue
+        if base == "graft_lint.py" and path.startswith("scripts/"):
+            legs.add("lint")
+            continue
+        # bench.py, bench_gate.py, the model/trainer core, anything
+        # else: no safe subset — run everything.
+        return set(ALL_LEGS)
+    return legs
+
 
 def machine_fingerprint() -> str:
     """Coarse same-machine identity: CPU model x core count.  Good enough
@@ -778,6 +863,123 @@ def gate_disagg(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_fleet_reference(repo: str = REPO):
+    """Fleet tokens/s from the committed multi-process fleet artifact
+    (docs/serving_fleet_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "serving_fleet_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = (data.get("fleet") or {}).get("tokens_per_sec")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_fleet(threshold: float, backend: str, fp: str) -> dict:
+    """The multi-process fleet regression gate: a short run of the
+    fleet bench (4 worker PROCESSES behind the socket router), gated —
+
+    1. **Invariants** (hard): every output byte-identical to in-driver
+       ``generate()`` — including the streams redistributed across a
+       real mid-stream ``SIGKILL`` — zero post-warmup compiles in
+       EVERY worker process (each worker's own ``compile_watch`` count
+       via ``/v1/spec``), zero client errors (refusals must be
+       structured, never hangs), socket migrations actually flowed,
+       chunked prefill actually engaged on the long-prompt mix, and
+       the autoscaler respawned the killed worker as a fresh process.
+    2. **Trajectory/local baseline** on the chunked fleet's mix
+       tokens/s, calibrate-then-ratchet as the other gates.  (The
+       chunked-TTFT win and the 0.9x tokens floor are pinned by the
+       committed artifact; the short gate run records the ratios
+       without re-litigating them against scheduler noise.)
+    """
+    import bench
+
+    result = bench.bench_serve_fleet(n_requests=24)
+    chaos = result.get("chaos") or {}
+    out = {
+        "fleet_tokens_per_sec": result["fleet"]["tokens_per_sec"],
+        "short_only_tokens_per_sec":
+            result["short_only"]["tokens_per_sec"],
+        "chunked_ttft_ratio": result["chunked_ttft_ratio"],
+        "chunked_tokens_ratio": result["chunked_tokens_ratio"],
+        "migrations": result["fleet"]["migrations"],
+        "kv_migrated_bytes": result["fleet"]["kv_migrated_bytes"],
+        "prefill_chunks": result["fleet"]["prefill_chunks"],
+        "chaos_redistributes": chaos.get("redistributes"),
+        "respawned_pid": chaos.get("respawned_pid"),
+        "threshold": threshold,
+    }
+    if not result["byte_identical"]:
+        out.update(ok=False, decided_by="identity",
+                   error="fleet output diverged from generate() "
+                   "(including post-SIGKILL streams)")
+        return out
+    if not result["zero_recompiles"]:
+        out.update(
+            ok=False, decided_by="zero_recompile",
+            error="worker-process compiles observed during a timed "
+            "pass: " + json.dumps({
+                m: result[m].get("worker_compiles_timed")
+                for m in ("fleet", "short_only", "unchunked")
+            }),
+        )
+        return out
+    n_err = sum(
+        result[m]["n_errors"]
+        for m in ("fleet", "short_only", "unchunked")
+    )
+    if n_err:
+        out.update(ok=False, decided_by="client_errors",
+                   error=f"{n_err} client error(s) across fleet legs")
+        return out
+    if result["fleet"]["migrations"] < 1 or \
+            result["fleet"]["kv_migrated_bytes"] <= 0:
+        out.update(
+            ok=False, decided_by="migration_coverage",
+            error="no socket KV migration flowed — the fleet leg is "
+            "not actually disaggregating across processes",
+        )
+        return out
+    if result["fleet"]["prefill_chunks"] < 1:
+        out.update(ok=False, decided_by="chunk_coverage",
+                   error="chunked prefill never engaged on the "
+                   "long-prompt mix")
+        return out
+    if chaos.get("respawned_pid") is None or \
+            not chaos.get("byte_identical"):
+        out.update(
+            ok=False, decided_by="chaos_recovery",
+            error=f"SIGKILL recovery failed: {chaos}",
+        )
+        return out
+    committed = committed_fleet_reference()
+    fleet_key = f"{backend}_serve_fleet"
+    baseline = load_baseline(fleet_key, fp)
+    decision = evaluate(
+        float(result["fleet"]["tokens_per_sec"]),
+        committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            fleet_key, fp,
+            max(float(result["fleet"]["tokens_per_sec"]),
+                baseline or 0.0),
+        )
+    elif "error" not in out:
+        out["error"] = (
+            f"fleet {result['fleet']['tokens_per_sec']} tokens/s is "
+            f">{threshold * 100:.0f}% below this machine's baseline "
+            f"{baseline}"
+        )
+    return out
+
+
 def committed_overload_reference(repo: str = REPO):
     """Mitigated TTFT attainment from the committed serving-chaos
     artifact (docs/serving_chaos_cpu.json), or None."""
@@ -1185,50 +1387,78 @@ def main() -> int:
                         help="skip the graft-lint static-analysis gate")
     parser.add_argument("--skip-elastic", action="store_true",
                         help="skip the elastic-training chaos gate")
+    parser.add_argument("--skip-fleet", action="store_true",
+                        help="skip the multi-process serving-fleet gate")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="map the files changed vs --changed-ref to "
+                        "gate legs (legs_for_changes) and run only "
+                        "those — docs-only diffs gate nothing, a "
+                        "serving/ diff runs everything; when git cannot "
+                        "answer, every leg runs")
+    parser.add_argument("--changed-ref", default="origin/main",
+                        metavar="REF",
+                        help="git ref --changed-only diffs against "
+                        "(default origin/main)")
     args = parser.parse_args()
+
+    selected = set(ALL_LEGS)
+    if args.changed_only:
+        files = changed_files(args.changed_ref)
+        selected = legs_for_changes(files)
+        print(json.dumps({"bench_gate_changed_only": {
+            "ref": args.changed_ref,
+            "n_files": len(files) if files is not None else None,
+            "legs": sorted(selected),
+        }}), flush=True)
+        if not selected:
+            print("BENCH_GATE OK (changed_only): no gate legs "
+                  "selected by the diff", flush=True)
+            return 0
 
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     backend = jax.default_backend()
     fp = machine_fingerprint()
-    ref = reference_for(backend)
-    baseline = load_baseline(backend, fp)
 
     import bench  # the committed rows were measured through this module
 
-    fresh = 0.0
-    for _ in range(max(args.reps, 1)):
-        fresh = max(fresh, bench.bench_parity(args.batch_size))
+    if "parity" in selected:
+        ref = reference_for(backend)
+        baseline = load_baseline(backend, fp)
+        fresh = 0.0
+        for _ in range(max(args.reps, 1)):
+            fresh = max(fresh, bench.bench_parity(args.batch_size))
 
-    result = evaluate(
-        fresh, float(ref[1]["value"]) if ref else None, baseline,
-        args.threshold,
-    )
-    result.update({
-        "backend": backend,
-        "reference_round": ref[0] if ref else None,
-        "batch_size": args.batch_size,
-        "machine": fp,
-    })
-    if result["ok"]:
-        # Ratchet: remember the best this machine has ever shown.
-        save_baseline(backend, fp, max(fresh, baseline or 0.0))
-    print(json.dumps({"bench_gate": result}), flush=True)
-    if not result["ok"]:
+        result = evaluate(
+            fresh, float(ref[1]["value"]) if ref else None, baseline,
+            args.threshold,
+        )
+        result.update({
+            "backend": backend,
+            "reference_round": ref[0] if ref else None,
+            "batch_size": args.batch_size,
+            "machine": fp,
+        })
+        if result["ok"]:
+            # Ratchet: remember the best this machine has ever shown.
+            save_baseline(backend, fp, max(fresh, baseline or 0.0))
+        print(json.dumps({"bench_gate": result}), flush=True)
+        if not result["ok"]:
+            print(
+                f"BENCH_GATE FAIL: {result['fresh_samples_per_sec']} "
+                f"samples/s is >{args.threshold * 100:.0f}% below this "
+                f"machine's baseline {result['local_baseline']} "
+                "samples/s",
+                flush=True,
+            )
+            return 1
         print(
-            f"BENCH_GATE FAIL: {result['fresh_samples_per_sec']} samples/s "
-            f"is >{args.threshold * 100:.0f}% below this machine's baseline "
-            f"{result['local_baseline']} samples/s",
+            f"BENCH_GATE OK ({result['decided_by']}): "
+            f"{result['fresh_samples_per_sec']} samples/s",
             flush=True,
         )
-        return 1
-    print(
-        f"BENCH_GATE OK ({result['decided_by']}): "
-        f"{result['fresh_samples_per_sec']} samples/s",
-        flush=True,
-    )
-    if not args.skip_serve:
+    if not args.skip_serve and "serve" in selected:
         serve = gate_serve_replay(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_serve": serve}), flush=True)
         if not serve["ok"]:
@@ -1242,7 +1472,7 @@ def main() -> int:
             f"{serve['ttft_p99_ratio']})",
             flush=True,
         )
-    if not args.skip_mixed:
+    if not args.skip_mixed and "mixed" in selected:
         mixed = gate_mixed(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_mixed": mixed}), flush=True)
         if not mixed["ok"]:
@@ -1254,7 +1484,7 @@ def main() -> int:
             f"{mixed['sharded_vs_fused_bf16']}x at bf16",
             flush=True,
         )
-    if not args.skip_pipeline:
+    if not args.skip_pipeline and "pipeline" in selected:
         pipe = gate_pipeline(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_pipeline": pipe}), flush=True)
         if not pipe["ok"]:
@@ -1267,7 +1497,7 @@ def main() -> int:
             f"(S=4/M=8), {pipe.get('f1b_steps_per_sec')} steps/s",
             flush=True,
         )
-    if not args.skip_slo:
+    if not args.skip_slo and "slo" in selected:
         slo = gate_slo(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_slo": slo}), flush=True)
         if not slo["ok"]:
@@ -1280,7 +1510,7 @@ def main() -> int:
             f"{slo['attainment']}",
             flush=True,
         )
-    if not args.skip_disagg:
+    if not args.skip_disagg and "disagg" in selected:
         disagg = gate_disagg(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_disagg": disagg}), flush=True)
         if not disagg["ok"]:
@@ -1294,7 +1524,23 @@ def main() -> int:
             f"{disagg['migrations']} migration(s)",
             flush=True,
         )
-    if not args.skip_lora:
+    if not args.skip_fleet and "fleet" in selected:
+        fleet = gate_fleet(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_fleet": fleet}), flush=True)
+        if not fleet["ok"]:
+            print(f"BENCH_GATE FLEET FAIL: {fleet.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE FLEET OK ({fleet['decided_by']}): "
+            f"{fleet['fleet_tokens_per_sec']} tokens/s across worker "
+            f"processes, chunked TTFT ratio "
+            f"{fleet['chunked_ttft_ratio']}, "
+            f"{fleet['migrations']} socket migration(s), respawned pid "
+            f"{fleet['respawned_pid']}",
+            flush=True,
+        )
+    if not args.skip_lora and "lora" in selected:
         lo = gate_lora(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_lora": lo}), flush=True)
         if not lo["ok"]:
@@ -1308,7 +1554,7 @@ def main() -> int:
             f"{lo['hot_load_tokens']} token(s)",
             flush=True,
         )
-    if not args.skip_overload:
+    if not args.skip_overload and "overload" in selected:
         ov = gate_overload(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_overload": ov}), flush=True)
         if not ov["ok"]:
@@ -1323,7 +1569,7 @@ def main() -> int:
             f"autoscaler {ov['autoscaler_actions']}",
             flush=True,
         )
-    if not args.skip_goodput:
+    if not args.skip_goodput and "goodput" in selected:
         gp = gate_goodput(args.threshold)
         print(json.dumps({"bench_gate_goodput": gp}), flush=True)
         if not gp["ok"]:
@@ -1336,7 +1582,7 @@ def main() -> int:
             f"{gp['post_warmup_compiles']} post-warmup compiles",
             flush=True,
         )
-    if not args.skip_elastic:
+    if not args.skip_elastic and "elastic" in selected:
         ela = gate_elastic(args.threshold, backend, fp)
         print(json.dumps({"bench_gate_elastic": ela}), flush=True)
         if not ela["ok"]:
@@ -1351,7 +1597,7 @@ def main() -> int:
             f"{ela['time_to_recover_secs']}s",
             flush=True,
         )
-    if not args.skip_lint:
+    if not args.skip_lint and "lint" in selected:
         lint = gate_lint()
         print(json.dumps({"bench_gate_lint": lint}), flush=True)
         if not lint["ok"]:

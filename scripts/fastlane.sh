@@ -68,9 +68,23 @@
 # overload gate (serving chaos: kill + slow with vs without the
 # mitigation stack — identity/recompile/structured-error invariants
 # hard, mitigated-vs-baseline attainment floor, chaos-attainment
-# ratchet vs docs/serving_chaos_cpu.json; --skip-overload to skip).
+# ratchet vs docs/serving_chaos_cpu.json; --skip-overload to skip),
+# and a multi-process serving-fleet smoke leg (scripts/fleet_smoke.py:
+# 4 REAL worker processes driven only over HTTP sockets — byte
+# identity through socket KV migration + chunked prefill, a real
+# SIGKILL mid-stream redistributed byte-identical, the autoscaler
+# respawning a real replacement process) backed by the fleet gate
+# (bench_gate.py gate_fleet: identity/zero-recompile/chunk-coverage/
+# chaos-recovery invariants hard, fleet tokens/s ratchet vs
+# docs/serving_fleet_cpu.json; --skip-fleet to skip).
+#
+# On a PR branch (HEAD != origin/main with origin/main resolvable) the
+# bench gate runs in --changed-only mode: the diff's files map to gate
+# legs (scripts/bench_gate.py legs_for_changes) so a docs-only PR
+# skips the heavy legs entirely.  FULL_GATE=1 forces the full run.
 #
 #   ./scripts/fastlane.sh            # from the repo root
+#   FULL_GATE=1 ./scripts/fastlane.sh  # full bench gate regardless of diff
 #
 # Exits non-zero if either leg fails; prints DOTS_PASSED=<n> as the
 # last line (the tier-1 count, unchanged by the smoke leg).
@@ -126,6 +140,10 @@ echo "# the bench gate's gate_elastic runs the full cross-process leg)"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py --quick
 elastic_rc=$?
 [ $elastic_rc -ne 0 ] && echo "# elastic smoke FAILED (rc=$elastic_rc)"
+echo "# multi-process serving-fleet smoke leg"
+timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+fleet_rc=$?
+[ $fleet_rc -ne 0 ] && echo "# fleet smoke FAILED (rc=$fleet_rc)"
 echo "# graft-lint static-analysis leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/graft_lint.py
 lint_rc=$?
@@ -141,7 +159,17 @@ else
   ruff_rc=0
 fi
 echo "# bench regression gate"
-timeout -k 10 2700 env JAX_PLATFORMS=cpu python scripts/bench_gate.py
+# On a PR branch, map the diff vs origin/main to gate legs and run
+# only those (--changed-only); FULL_GATE=1 or a missing/identical
+# origin/main falls back to the full gate.
+gate_args=""
+if [ -z "$FULL_GATE" ] \
+  && git rev-parse --verify -q origin/main >/dev/null 2>&1 \
+  && [ "$(git rev-parse HEAD)" != "$(git rev-parse origin/main)" ]; then
+  gate_args="--changed-only"
+  echo "# (PR branch: bench gate in --changed-only mode; FULL_GATE=1 overrides)"
+fi
+timeout -k 10 2700 env JAX_PLATFORMS=cpu python scripts/bench_gate.py $gate_args
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -156,6 +184,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$router_rc
 [ $rc -eq 0 ] && rc=$overload_rc
 [ $rc -eq 0 ] && rc=$elastic_rc
+[ $rc -eq 0 ] && rc=$fleet_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc
 [ $rc -eq 0 ] && rc=$gate_rc

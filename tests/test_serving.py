@@ -8,6 +8,7 @@ slot recycling on EOS, admission backpressure, deadlines, metrics, and
 the stdlib HTTP front end.
 """
 
+import os
 import time
 
 import jax
@@ -241,9 +242,15 @@ def test_health_payload_golden_shape(model_and_vars):
         "active_requests", "active_slots", "adapters_resident",
         "adoptions_pending", "closed", "degradation_level", "draining",
         "healthy", "kv_pages_free", "kv_pages_total", "max_slots", "ok",
-        "queue_depth", "queued_requests", "reason", "role",
+        "pid", "queue_depth", "queued_requests", "reason", "role",
+        "transport", "uptime_s",
     ]
     assert payload["ok"] is True and payload["role"] == "decode"
+    # Process-identity fields (serving/fleet.py routes on these to tell
+    # a worker process from an in-process replica).
+    assert payload["pid"] == os.getpid()
+    assert payload["transport"] == "inproc"
+    assert payload["uptime_s"] >= 0
     assert payload["active_slots"] == 0 and payload["queue_depth"] == 0
     assert payload["max_slots"] == 2
     # Paged server: the pool gauges are live numbers the router ranks on.
