@@ -68,6 +68,7 @@ def build_train_specs(precisions=("fp32", "bf16"),
     flavors = [(p, "fused") for p in precisions]
     if sharded:
         flavors.append(("bf16", "sharded"))
+        flavors.append(("fp32", "sharded_fused"))
 
     def sets():
         return (
@@ -78,7 +79,8 @@ def build_train_specs(precisions=("fp32", "bf16"),
     for precision, dp_update in flavors:
         extra = {}
         label = precision
-        if dp_update == "sharded":
+        optimizer = "adamw"
+        if dp_update in ("sharded", "sharded_fused"):
             # The mesh must cover the host's devices (2 in the CLI's
             # forced-virtual-device process, 8 on the test harness).
             extra = {
@@ -86,9 +88,17 @@ def build_train_specs(precisions=("fp32", "bf16"),
                 "mesh_shape": {"data": jax.device_count()},
             }
             label = f"{precision},sharded"
+        if dp_update == "sharded_fused":
+            # The fused optimizer tail (ops/kernels/fused_adam.py) only
+            # engages for bare adam at weight_decay=0; force it on so
+            # the kernel-backed update program is held to the same
+            # donation / collective contracts as the optax one.
+            optimizer = "adam"
+            extra["fused_adam"] = True
+            label = f"{precision},sharded,fused_adam"
         trainer = Trainer(
             MLModel(), datasets=sets(),
-            epochs=1, batch_size=16, lr=0.01, optimizer="adamw",
+            epochs=1, batch_size=16, lr=0.01, optimizer=optimizer,
             metric=None, precision=precision,
             model_dir=tempfile.mkdtemp(prefix="graft_lint_train_"),
             **extra,
@@ -104,7 +114,7 @@ def build_train_specs(precisions=("fp32", "bf16"),
             policy=precision,
             lower_text=_lower_text_thunk(traced) if with_lowered else None,
         ))
-        if dp_update == "sharded":
+        if dp_update != "fused":
             continue  # one eval step per precision is enough
         ev = trainer._eval_step.trace(
             trainer._state_variables(), jnp.asarray(x), jnp.asarray(y)
@@ -190,6 +200,21 @@ def build_decode_specs(paged: bool = True, spec_k: int = 2,
                 np.zeros((2,), np.uint32), np.int32(0), np.int32(0),
             ),
         ))
+        # The kernel-backed paged decode (ops/kernels/paged_attention.py
+        # behind ``paged_kernel=True``): same engine surface, but the
+        # page-table gather is fused into the attention program — trace
+        # it so the Pallas path carries the same donation and dtype
+        # contracts as the gather twin it replaces.
+        keng = SlotDecodeEngine(
+            model, variables, max_batch=2, kv_page_size=16,
+            paged_kernel=True,
+        )
+        traced_pk = keng._decode.trace(*decode_args(keng))
+        specs.append(ProgramSpec(
+            name="serve_decode[paged_kernel]", traced=traced_pk,
+            lower_text=_lower_text_thunk(traced_pk) if with_lowered
+            else None,
+        ))
 
     if spec_k:
         seng = SlotDecodeEngine(
@@ -204,6 +229,18 @@ def build_decode_specs(paged: bool = True, spec_k: int = 2,
                 seng._temps, seng._rngs, seng._steps,
             ),
         ))
+
+    # The int8 weight-quantized decode (ops/kernels/int8_matmul.py
+    # behind ``quant_int8=True``): the quant collection rides as an
+    # ordinary non-donated program input so hot-swapping scales never
+    # recompiles — the trace pins that calling convention.
+    qeng = SlotDecodeEngine(model, variables, max_batch=2,
+                            quant_int8=True)
+    traced_q = qeng._decode.trace(*decode_args(qeng), qeng._quant)
+    specs.append(ProgramSpec(
+        name="serve_decode[int8]", traced=traced_q,
+        lower_text=_lower_text_thunk(traced_q) if with_lowered else None,
+    ))
 
     # Batched-LoRA programs (serving/adapter_pool.py): the per-row
     # adapter-gathered decode step and the adapter-aware prefill — the

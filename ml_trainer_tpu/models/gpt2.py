@@ -106,6 +106,13 @@ class GPT2(nn.Module):
     # shared page pool + per-row page tables (models/layers.py).
     kv_page_size: int = 0
     kv_pages: int = 0
+    # Pallas kernel knobs (ops/kernels/): fused paged-attention decode
+    # and int8 weight-quantized projections.  Both resolve to lax
+    # references off-TPU, so byte-identity holds on CPU; the engine owns
+    # the refusal rules (paged_kernel needs kv_page_size > 0, quant_int8
+    # excludes spec_k / adapters).
+    paged_kernel: bool = False
+    quant_int8: bool = False
     # LoRA (models/layers.py lora_delta; docs/serving.md "Batched LoRA
     # adapters"): rank > 0 adds low-rank deltas on ``lora_targets``.
     # ``lora_slots == 0`` is TRAIN mode (one trainable adapter as
@@ -157,6 +164,7 @@ class GPT2(nn.Module):
                 decode=self.decode,
                 decode_max_len=self.max_len if self.decode else 0,
                 kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
+                paged_kernel=self.paged_kernel, quant_int8=self.quant_int8,
                 lora_rank=self.lora_rank, lora_alpha=self.lora_alpha,
                 lora_slots=self.lora_slots, lora_targets=self.lora_targets,
                 name=f"block{i}",

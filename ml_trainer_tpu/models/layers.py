@@ -85,6 +85,35 @@ def lora_delta(mdl: nn.Module, name: str, x, features: int,
     return (x @ A.astype(mdl.dtype) @ B.astype(mdl.dtype)) * scale
 
 
+def _quant_dense(mdl: nn.Module, name: str, x, features: int):
+    """Int8 weight-quantized replacement for Dense target ``name``.
+
+    Reads ``<name>_w`` (int8 [K, N]) / ``<name>_scale`` (f32 [N]) /
+    ``<name>_b`` (f32 [N]) from the ``"quant"`` collection — built
+    host-side by ``ops.kernels.quantize_tree`` from the fp32 params, so
+    param paths and checkpoints never change and only the decode model
+    clone flips the knob.  The fp32 ``kernel``/``bias`` params go unread
+    by this program (flax apply tolerates unused collections entries).
+    """
+    from ml_trainer_tpu.ops.kernels.int8_matmul import int8_matmul
+
+    in_dim = x.shape[-1]
+    w = mdl.variable(
+        "quant", f"{name}_w",
+        lambda: jnp.zeros((in_dim, features), jnp.int8),
+    ).value
+    s = mdl.variable(
+        "quant", f"{name}_scale",
+        lambda: jnp.ones((features,), jnp.float32),
+    ).value
+    b = mdl.variable(
+        "quant", f"{name}_b",
+        lambda: jnp.zeros((features,), jnp.float32),
+    ).value
+    y = int8_matmul(x.astype(mdl.dtype), w, s)
+    return y + b.astype(y.dtype)
+
+
 class MultiHeadAttention(nn.Module):
     """Self-attention over [B, S, E] with heads split for ops.attention.
 
@@ -113,6 +142,16 @@ class MultiHeadAttention(nn.Module):
     # memory tracks live tokens and identical prefixes can share pages.
     kv_page_size: int = 0
     kv_pages: int = 0
+    # Pallas paged-attention decode (ops/kernels/paged_attention.py):
+    # fuse the page-table gather into the attention kernel on the S == 1
+    # step.  'auto' dispatch resolves to the lax reference off-TPU —
+    # bitwise the gather path below — so flipping this knob never
+    # changes bytes on CPU.
+    paged_kernel: bool = False
+    # Int8 weight-quantized decode (ops/kernels/int8_matmul.py): the
+    # qkv/proj projections read int8 weights + per-column scales from
+    # the "quant" collection instead of the fp32 params.
+    quant_int8: bool = False
     # LoRA (see lora_delta): rank > 0 adds low-rank deltas on the
     # targeted projections — trainable single-adapter params when
     # lora_slots == 0, the serving engine's per-row-indexed adapter pool
@@ -130,7 +169,10 @@ class MultiHeadAttention(nn.Module):
         inner = self.num_heads * head_dim
         # Fused QKV projection: one [E, 3·inner] matmul keeps the MXU busy
         # and gives tensor parallelism a single column-sharded kernel.
-        qkv = nn.Dense(3 * inner, dtype=self.dtype, name="qkv")(x)
+        if self.quant_int8:
+            qkv = _quant_dense(self, "qkv", x, 3 * inner)
+        else:
+            qkv = nn.Dense(3 * inner, dtype=self.dtype, name="qkv")(x)
         if self.lora_rank and "qkv" in self.lora_targets:
             qkv = qkv + lora_delta(self, "qkv", x, 3 * inner, adapter_idx)
         q, k, v = jnp.split(qkv, 3, axis=-1)
@@ -156,7 +198,10 @@ class MultiHeadAttention(nn.Module):
         b, h, s, d = out.shape
         out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
         attn_out = out
-        out = nn.Dense(embed, dtype=self.dtype, name="proj")(out)
+        if self.quant_int8:
+            out = _quant_dense(self, "proj", out, embed)
+        else:
+            out = nn.Dense(embed, dtype=self.dtype, name="proj")(out)
         if self.lora_rank and "proj" in self.lora_targets:
             out = out + lora_delta(self, "proj", attn_out, embed,
                                    adapter_idx)
@@ -328,6 +373,25 @@ class MultiHeadAttention(nn.Module):
         pool_v.value = scatter(pool_v.value, v)
         idx_var.value = idx + s
 
+        # -- read ---------------------------------------------------------
+        if self.paged_kernel and s == 1:
+            # Fused path (ops/kernels/paged_attention.py): the kernel
+            # pulls pages straight off the table instead of the XLA
+            # gather below materializing [B, H, L, D] twice per step.
+            # Same mask semantics: lengths = idx + 1 (this step's token
+            # included), and the kernel fetches the very pages the
+            # gather would — 'auto' resolves to the lax reference
+            # (bitwise this gather path) off-TPU.
+            from ml_trainer_tpu.ops.kernels.paged_attention import (
+                paged_attention,
+            )
+
+            out = paged_attention(
+                q[:, :, 0, :], pool_k.value, pool_v.value, table,
+                idx_vec + 1,
+            )
+            return out[:, :, None, :]
+
         # -- read: gather pages back into logical order ------------------
         def gather(pool):  # [B, P, H, page, D] -> [B, H, L, D]
             g = pool[table]
@@ -350,6 +414,8 @@ class MLP(nn.Module):
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
     activation: Callable = nn.gelu
+    # Int8 weight-quantized projections (see MultiHeadAttention).
+    quant_int8: bool = False
     # LoRA (see lora_delta / MultiHeadAttention).
     lora_rank: int = 0
     lora_alpha: float = 1.0
@@ -359,12 +425,18 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False, adapter_idx=None):
         embed = x.shape[-1]
-        h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc_in")(x)
+        if self.quant_int8:
+            h = _quant_dense(self, "fc_in", x, self.hidden_dim)
+        else:
+            h = nn.Dense(self.hidden_dim, dtype=self.dtype, name="fc_in")(x)
         if self.lora_rank and "fc_in" in self.lora_targets:
             h = h + lora_delta(self, "fc_in", x, self.hidden_dim,
                                adapter_idx)
         h = self.activation(h)
-        out = nn.Dense(embed, dtype=self.dtype, name="fc_out")(h)
+        if self.quant_int8:
+            out = _quant_dense(self, "fc_out", h, embed)
+        else:
+            out = nn.Dense(embed, dtype=self.dtype, name="fc_out")(h)
         if self.lora_rank and "fc_out" in self.lora_targets:
             out = out + lora_delta(self, "fc_out", h, embed, adapter_idx)
         if self.dropout_rate:
@@ -421,6 +493,8 @@ class TransformerBlock(nn.Module):
     decode_max_len: int = 0
     kv_page_size: int = 0  # >0: paged KV pool (see MultiHeadAttention)
     kv_pages: int = 0
+    paged_kernel: bool = False  # fused paged-attention decode kernel
+    quant_int8: bool = False    # int8 weight-quantized projections
     # LoRA (see lora_delta): threaded to the attention/MLP projections.
     lora_rank: int = 0
     lora_alpha: float = 1.0
@@ -440,6 +514,7 @@ class TransformerBlock(nn.Module):
             mesh=self.mesh, decode=self.decode,
             decode_max_len=self.decode_max_len,
             kv_page_size=self.kv_page_size, kv_pages=self.kv_pages,
+            paged_kernel=self.paged_kernel, quant_int8=self.quant_int8,
             name="attn", **lora_kw,
         )(y, mask=mask, train=train, kv_lens=kv_lens,
           **({"adapter_idx": adapter_idx} if self.lora_rank else {}))
@@ -453,7 +528,7 @@ class TransformerBlock(nn.Module):
         else:
             mlp = lambda y: MLP(
                 self.mlp_dim, dropout_rate=self.dropout_rate, dtype=self.dtype,
-                name="mlp", **lora_kw,
+                quant_int8=self.quant_int8, name="mlp", **lora_kw,
             )(y, train=train,
               **({"adapter_idx": adapter_idx} if self.lora_rank else {}))
         ln1 = nn.LayerNorm(dtype=self.dtype, name="ln1")

@@ -1,0 +1,57 @@
+"""Pallas TPU kernels for the serving + training hot paths.
+
+Every kernel in this package ships as a PAIR under one dispatcher:
+
+* a **lax reference** — ordinary jnp/lax ops, bitwise-identical to the
+  pre-kernel XLA path it replaces (that identity is pinned by
+  ``tests/test_kernels.py``), shipped as the CPU/GPU runtime path;
+* a **Pallas TPU kernel** — the fused program that removes the HBM
+  round-trips the XLA path pays, pinned bit-for-bit against the lax
+  reference in interpret mode on CPU (the repo's kernel discipline,
+  same as ``ops/attention.py``'s flash kernel).
+
+``implementation='auto'`` resolves to the Pallas kernel on TPU and the
+lax reference everywhere else, so enabling a kernel knob never changes
+bytes on a non-TPU backend — byte-identity gates stay exact while the
+TPU path earns the fusion win.
+
+Catalog (see docs/kernels.md for block layouts and measured numbers):
+
+* ``paged_attention`` — paged-attention decode: fuses the per-step
+  page-table gather (``pool[table]`` materializing [B, H, L, D] twice)
+  into the attention kernel; pages stream HBM->VMEM via a
+  scalar-prefetched table index_map.
+* ``unscale_sqsum`` / ``fused_adam_update`` — the ``dp_update='sharded'``
+  optimizer tail: one pass over the 1/N dim-0 shard for unscale +
+  global-norm contribution, and one for clip + Adam moments + schedule
+  step + param write (optax opt_state structure preserved bit-for-bit).
+* ``int8_matmul`` / ``quantize_per_channel`` — int8 weight-quantized
+  matmul with per-output-channel scales, backing the opt-in quantized
+  decode path (``Server(quant_int8=True)``).
+"""
+
+from ml_trainer_tpu.ops.kernels.paged_attention import (  # noqa: F401
+    paged_attention,
+    paged_attention_reference,
+)
+from ml_trainer_tpu.ops.kernels.fused_adam import (  # noqa: F401
+    adam_scalars,
+    fused_adam_update,
+    unscale_sqsum,
+)
+from ml_trainer_tpu.ops.kernels.int8_matmul import (  # noqa: F401
+    int8_matmul,
+    quantize_per_channel,
+    quantize_tree,
+)
+
+__all__ = [
+    "paged_attention",
+    "paged_attention_reference",
+    "adam_scalars",
+    "fused_adam_update",
+    "unscale_sqsum",
+    "int8_matmul",
+    "quantize_per_channel",
+    "quantize_tree",
+]

@@ -163,6 +163,8 @@ class Server:
                  draft_variables: Optional[dict] = None,
                  watchdog_timeout: Optional[float] = 60.0,
                  kv_page_size: int = 0, kv_pages: int = 0,
+                 paged_kernel: bool = False,
+                 quant_int8: bool = False,
                  prefix_cache: bool = True,
                  prefix_scope: str = "tenant",
                  tenants: Optional[dict] = None,
@@ -224,7 +226,22 @@ class Server:
         CHUNKED PREFILL: a prompt longer than the chunk admits through
         page-aligned continuation windows with decode ticks interleaved
         between windows, so one long prompt cannot head-of-line-block
-        every short request's TTFT (docs/serving.md)."""
+        every short request's TTFT (docs/serving.md).
+
+        ``paged_kernel`` (needs paged KV) runs the S == 1 decode step
+        through the fused Pallas paged-attention kernel
+        (ops/kernels/paged_attention.py; docs/kernels.md) — the
+        page-table gather streams HBM->VMEM inside the kernel instead
+        of materializing [B, H, L, D] twice per step.  Off-TPU the knob
+        dispatches to the lax reference, which IS the gather path, so
+        outputs stay byte-identical.
+
+        ``quant_int8`` serves the decode step with int8-quantized
+        qkv/proj/fc_in/fc_out weights + per-column scales
+        (ops/kernels/int8_matmul.py; prefill stays fp32).  Opt-in and
+        quality-gated (argmax agreement vs fp32 on the bench leg), NOT
+        bit-identical to fp32; refused with ``spec_k > 0`` or
+        ``adapters``."""
         if role not in ("prefill", "decode", "both"):
             raise ValueError(
                 f"role must be 'prefill', 'decode' or 'both', got {role!r}"
@@ -238,6 +255,7 @@ class Server:
             model, variables, max_batch=max_batch, metrics=self.metrics,
             spec_k=spec_k, drafter=drafter, draft_variables=draft_variables,
             kv_page_size=kv_page_size, kv_pages=kv_pages,
+            paged_kernel=paged_kernel, quant_int8=quant_int8,
             prefix_cache=prefix_cache, prefix_scope=prefix_scope,
             max_preemptions=max_preemptions, adapters=adapters,
             prefill_chunk=prefill_chunk,

@@ -143,6 +143,8 @@ class SlotDecodeEngine:
                  draft_variables: Optional[dict] = None,
                  ngram_n: int = 3,
                  kv_page_size: int = 0, kv_pages: int = 0,
+                 paged_kernel: bool = False,
+                 quant_int8: bool = False,
                  prefix_cache: bool = True,
                  prefix_scope: str = "tenant",
                  max_preemptions: int = 8,
@@ -206,6 +208,44 @@ class SlotDecodeEngine:
                 raise ValueError("kv_pages needs kv_page_size > 0")
             self.kv_pages = 0
             self._key_model = model
+
+        # -- Pallas kernel knobs (ops/kernels/; docs/kernels.md) --------
+        # paged_kernel fuses the page-table gather into the S == 1
+        # decode attention; quant_int8 swaps the decode projections to
+        # int8 weights + per-column scales (prefill and verify stay
+        # fp32).  Both dispatch to lax references off-TPU, so CPU bytes
+        # never change when a knob flips.
+        self.paged_kernel = bool(paged_kernel)
+        if self.paged_kernel:
+            if not self.paged:
+                raise ValueError(
+                    "paged_kernel needs paged KV (kv_page_size > 0): "
+                    "the kernel fuses the page-table gather into the "
+                    "decode attention step"
+                )
+            try:
+                self._key_model = self._key_model.clone(paged_kernel=True)
+            except TypeError as e:
+                raise ValueError(
+                    f"{type(model).__name__} does not carry the "
+                    "paged_kernel knob (only the GPT-2 family)"
+                ) from e
+        self.quant_int8 = bool(quant_int8)
+        if self.quant_int8:
+            if spec_k:
+                raise ValueError(
+                    "quant_int8 with spec_k > 0 is not supported: the "
+                    "verify window runs the fp32 program, so acceptance "
+                    "would compare int8 drafts against fp32 verify "
+                    "(serve quantized with spec_k=0)"
+                )
+            if adapters is not None:
+                raise ValueError(
+                    "quant_int8 with adapters is not supported: LoRA "
+                    "deltas attach to the fp32 projections the "
+                    "quantized program does not read (serve quantized "
+                    "without adapters)"
+                )
 
         # -- chunked prefill (opt-in; page-aligned windows) --------------
         # Long prompts prefill in ``prefill_chunk``-token windows through
@@ -292,6 +332,29 @@ class SlotDecodeEngine:
         self.params = (
             variables["params"] if "params" in variables else variables
         )
+        # Decode-only int8 clone + the host-built "quant" collection
+        # (ops/kernels/quantize_tree): prefill / verify / continuation
+        # windows keep running the fp32 ``self.dm`` programs — only the
+        # S == 1 decode program reads the quantized weights.
+        self._dm_quant = None
+        self._quant = None
+        if self.quant_int8:
+            try:
+                self._dm_quant = self.dm.clone(quant_int8=True)
+            except TypeError as e:
+                raise ValueError(
+                    f"{type(model).__name__} does not carry the "
+                    "quant_int8 knob (only the GPT-2 family)"
+                ) from e
+            from ml_trainer_tpu.ops.kernels.int8_matmul import quantize_tree
+
+            self._quant = quantize_tree(self.params)
+            if not self._quant:
+                raise ValueError(
+                    "quant_int8 found no quantizable projections in the "
+                    "params tree (expected qkv/proj/fc_in/fc_out Dense "
+                    "kernels)"
+                )
 
         # Batch-1 cache shapes for prefill; slot cache at max_batch with
         # the scalar index leaves widened to [max_batch] vectors.
@@ -373,7 +436,9 @@ class SlotDecodeEngine:
         self._profiler = StepProfiler("serve")
 
         self._decode = self._program(
-            ("serve_decode", self._key_model, max_batch), self._build_decode
+            ("serve_decode_int8" if self.quant_int8 else "serve_decode",
+             self._key_model, max_batch),
+            self._build_decode,
         )
         if self.paged:
             self._insert = self._program(
@@ -467,6 +532,22 @@ class SlotDecodeEngine:
 
     def _build_decode(self):
         dm = self.dm
+
+        if self.quant_int8:
+            qdm = self._dm_quant
+
+            def step_quant(params, cache, tok, temps, rngs, steps, quant):
+                # ``quant`` rides as an ordinary (non-donated) program
+                # input, like the LoRA stacks: re-quantizing after a
+                # weight hot-swap never recompiles.
+                logits, mut = qdm.apply(
+                    {"params": params, "cache": cache, "quant": quant},
+                    tok, train=False, mutable=["cache"],
+                )
+                nxt = _sample_rows(logits[:, -1], temps, rngs, steps)
+                return mut["cache"], nxt[:, None].astype(jnp.int32)
+
+            return jax.jit(step_quant, donate_argnums=(1, 2))
 
         if self._lora_on:
             def step_lora(params, cache, tok, temps, rngs, steps, lora):
@@ -1477,7 +1558,9 @@ class SlotDecodeEngine:
         active_before = len(self._active)
         t0 = time.perf_counter()
         extra = (
-            (self._lora_vars(self._adapter_rows),) if self._lora_on else ()
+            (self._lora_vars(self._adapter_rows),) if self._lora_on
+            else (self._quant,) if self.quant_int8
+            else ()
         )
         with span("serve_decode", engine_step=self._step_seq,
                   active=active_before, requests=step_requests):

@@ -51,10 +51,21 @@ PEAK_FLOPS_BY_DTYPE = {
         "v5e": 98.5e12, "v5 lite": 98.5e12, "v5lite": 98.5e12,
         "v4": 137.5e12,
     },
+    # Int8 matmul peaks (the quantized-decode path's honest MFU
+    # denominator, ops/kernels/int8_matmul.py): 2x the bf16 MXU rate on
+    # generations with native int8 MACs; v4 has none and runs int8
+    # operands through the bf16 pipeline at the bf16 rate.
+    "int8": {
+        "v6e": 1836e12, "v6": 1836e12,
+        "v5p": 918e12,
+        "v5e": 394e12, "v5 lite": 394e12, "v5lite": 394e12,
+        "v4": 275e12,
+    },
 }
 _DTYPE_ALIASES = {
     "bf16": "bf16", "bfloat16": "bf16",
     "fp32": "fp32", "float32": "fp32", "f32": "fp32",
+    "int8": "int8", "i8": "int8",
 }
 # Back-compat alias (pre-dtype-keyed callers read the bf16 table).
 PEAK_FLOPS = PEAK_FLOPS_BY_DTYPE["bf16"]

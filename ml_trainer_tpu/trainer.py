@@ -201,6 +201,7 @@ class Trainer:
         precision: Any = None,
         loss_scale: Any = "dynamic",
         dp_update: str = "fused",
+        fused_adam: Optional[bool] = None,
         bucket_mb: float = 4.0,
         pipeline_schedule: Optional[str] = None,
         elastic: Any = None,
@@ -533,6 +534,36 @@ class Trainer:
         if bucket_mb <= 0:
             raise ValueError(f"bucket_mb must be positive, got {bucket_mb}")
         self.dp_update = dp_update
+        # Fused unscale+clip+Adam kernels for the sharded optimizer tail
+        # (ops/kernels/fused_adam.py; docs/kernels.md).  None = auto:
+        # on exactly when the sharded step runs plain Adam with no
+        # weight decay — the one config whose optax op chain the fused
+        # kernels replicate bit-for-bit (trajectory test-pinned).
+        # Explicit True on an ineligible config is an error, not a
+        # silent fallback.
+        if fused_adam:
+            if dp_update != "sharded":
+                raise ValueError(
+                    "fused_adam=True needs dp_update='sharded': the "
+                    "fused kernels replace the sharded step's optimizer "
+                    "tail (the fused step keeps optax's single jit)"
+                )
+            if self.optimizer_type != "adam":
+                raise ValueError(
+                    "fused_adam=True supports optimizer='adam' only "
+                    f"(got {self.optimizer_type!r}): the kernels "
+                    "replicate optax.adam's exact op chain"
+                )
+            if self.weight_decay:
+                raise ValueError(
+                    "fused_adam=True needs weight_decay=0: coupled L2 "
+                    "prepends add_decayed_weights, which the fused "
+                    "kernels do not replicate"
+                )
+        self.fused_adam = (
+            dp_update == "sharded" and self.optimizer_type == "adam"
+            and not self.weight_decay and lora is None
+        ) if fused_adam is None else bool(fused_adam)
         self.bucket_mb = float(bucket_mb)
         if pipeline_schedule is not None:
             from ml_trainer_tpu.parallel.pipeline import SCHEDULES
@@ -803,6 +834,7 @@ class Trainer:
                     "nothing to shard; falling back to the fused step."
                 )
                 self.dp_update = "fused"
+                self.fused_adam = False
             elif not self._shard_opt_state:
                 # The sharded update owns 1/N of the moments by
                 # construction — ZeRO-1 placement is implied.
@@ -1668,6 +1700,19 @@ class Trainer:
         grads_for = self._make_grads_for()
         param_leaves = jax.tree.leaves(self.state.params)
         full_shapes = [leaf.shape for leaf in param_leaves]
+        # Fused optimizer-tail kernels (ops/kernels/fused_adam.py):
+        # eligibility was resolved in __init__ (plain Adam, wd=0).  The
+        # fused path computes bit-for-bit the unfused optax chain —
+        # pinned by the golden-trajectory test — while reading each
+        # shard once per pass instead of once per optax op.
+        use_fused = self.fused_adam
+        lr_sched = self.lr_schedule
+        if use_fused:
+            from ml_trainer_tpu.ops.kernels.fused_adam import (
+                adam_scalars,
+                fused_adam_update,
+                unscale_sqsum,
+            )
 
         def split_sq(leaves):
             """(local-shard sq-sum, replicated sq-sum) of a mixed tree —
@@ -1739,10 +1784,27 @@ class Trainer:
             # Scatter/psum SUMMED local-mean grads: /n folds the replica
             # mean, /accum the microbatch mean, /scale the loss scale.
             denom = float(n * accum)
-            if scale is None:
-                g_leaves = [g / denom for g in g_leaves]
+            d = denom if scale is None else denom * scale
+            need_sq = clip is not None or telemetry
+            sq_loc = sq_rep = None
+            if use_fused:
+                # One read of each shard yields BOTH the unscaled grad
+                # and its f32 squared-norm contribution (the unfused
+                # path reads the shard again in split_sq below).
+                sq_loc = jnp.zeros((), jnp.float32)
+                sq_rep = jnp.zeros((), jnp.float32)
+                unscaled = []
+                for i, g in enumerate(g_leaves):
+                    g_u, s = unscale_sqsum(g, d, compute_sq=need_sq)
+                    unscaled.append(g_u)
+                    if need_sq:
+                        sq_loc, sq_rep = (
+                            (sq_loc + s, sq_rep) if plan.sharded[i]
+                            else (sq_loc, sq_rep + s)
+                        )
+                g_leaves = unscaled
             else:
-                g_leaves = [g / (denom * scale) for g in g_leaves]
+                g_leaves = [g / d for g in g_leaves]
 
             # (3) this replica's parameter shards (dim-0 block at its
             # axis index), moments arrive pre-sharded via in_specs.
@@ -1762,21 +1824,73 @@ class Trainer:
             grads_mixed = jax.tree.unflatten(g_def, g_leaves)
 
             g_sq = None
-            if clip is not None or telemetry:
-                loc, rep = split_sq(g_leaves)
+            factor = None
+            if need_sq:
+                if use_fused:
+                    loc, rep = sq_loc, sq_rep
+                else:
+                    loc, rep = split_sq(g_leaves)
                 g_sq = col.psum(loc, "data") + rep
             if clip is not None:
                 # optax.clip_by_global_norm math over the TRUE global
                 # norm (the chained optax clip would see one shard).
                 gnorm = jnp.sqrt(g_sq)
                 factor = clip / jnp.maximum(gnorm, clip)
-                grads_mixed = jax.tree.map(lambda g: g * factor, grads_mixed)
+                if not use_fused:
+                    grads_mixed = jax.tree.map(
+                        lambda g: g * factor, grads_mixed
+                    )
 
-            updates, new_opt = tx.update(
-                grads_mixed, state.opt_state, params_mixed
-            )
-            updates = jax.tree.map(lambda u: u * lr_scale, updates)
-            new_params_mixed = optax.apply_updates(params_mixed, updates)
+            if use_fused:
+                # Fused tail: clip ×, Adam moments, bias corrections,
+                # schedule step, lr_scale and the param write in ONE
+                # pass per leaf shard; opt_state rebuilt in optax's
+                # exact chain(identity, adam(schedule)) structure, so
+                # checkpoints and the guard's where-selects are
+                # untouched.  The clip factor folds into the kernel
+                # instead of a separate grads multiply.
+                _e, (adam_st, sched_st) = state.opt_state
+                count_inc, bc1, bc2, step_size, sched_inc = adam_scalars(
+                    adam_st.count, sched_st.count, lr_sched
+                )
+                outs = [
+                    fused_adam_update(
+                        g, p, mu, nu, bc1=bc1, bc2=bc2,
+                        step_size=step_size, lr_scale=lr_scale,
+                        factor=factor,
+                    )
+                    for g, p, mu, nu in zip(
+                        jax.tree.leaves(grads_mixed),
+                        jax.tree.leaves(params_mixed),
+                        jax.tree.leaves(adam_st.mu),
+                        jax.tree.leaves(adam_st.nu),
+                    )
+                ]
+                new_params_mixed = jax.tree.unflatten(
+                    g_def, [o[0] for o in outs]
+                )
+                new_opt = (
+                    optax.EmptyState(),
+                    (
+                        optax.ScaleByAdamState(
+                            count=count_inc,
+                            mu=jax.tree.unflatten(
+                                g_def, [o[1] for o in outs]
+                            ),
+                            nu=jax.tree.unflatten(
+                                g_def, [o[2] for o in outs]
+                            ),
+                        ),
+                        optax.ScaleByScheduleState(count=sched_inc),
+                    ),
+                )
+                updates = jax.tree.unflatten(g_def, [o[3] for o in outs])
+            else:
+                updates, new_opt = tx.update(
+                    grads_mixed, state.opt_state, params_mixed
+                )
+                updates = jax.tree.map(lambda u: u * lr_scale, updates)
+                new_params_mixed = optax.apply_updates(params_mixed, updates)
 
             new_skipped, new_streak = state.skipped_steps, state.bad_streak
             replace_kwargs = {}

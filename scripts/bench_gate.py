@@ -79,6 +79,16 @@ cadence bound, and the time-to-recover rate ratchets against
 threshold floored at 0.5 — wall-clock recovery breathes on shared
 containers).
 
+A ninth leg (``gate_kernels``, skip with ``--skip-kernels``) gates the
+``ops/kernels/`` Pallas pass: interpret-mode bit parity for all three
+kernels (paged-attention decode, fused sharded-Adam tail, int8
+weight-quantized matmul), engine byte identity gather-vs-paged_kernel
+and the zero-post-warmup-recompile pin are hard invariants, the
+paged_kernel decode step must hold 0.5x the gather engine's rate
+(machine-independent — off-TPU both run the same reference program),
+and the kernel-engine decode steps/s ratchets against
+``docs/kernels_cpu.json`` / this machine's ``cpu_kernels`` baseline.
+
 Exit non-zero = regression.  Threshold override:
 ``ML_TRAINER_TPU_BENCH_GATE_THRESHOLD`` (fraction, e.g. ``0.15``).
 """
@@ -102,7 +112,7 @@ BASELINE_FILE = os.path.join(REPO, ".bench_gate_baseline.json")
 # train-step gate; the rest match their gate_<name> function.
 ALL_LEGS = frozenset({
     "parity", "serve", "mixed", "pipeline", "slo", "disagg", "lora",
-    "overload", "goodput", "elastic", "lint", "fleet",
+    "overload", "goodput", "elastic", "lint", "fleet", "kernels",
 })
 
 # Committed artifacts map to exactly the leg that ratchets against
@@ -119,6 +129,7 @@ _ARTIFACT_LEGS = {
     "memory_goodput_cpu.json": "goodput",
     "elastic_chaos_cpu.json": "elastic",
     "graft_lint_baseline.json": "lint",
+    "kernels_cpu.json": "kernels",
 }
 
 
@@ -172,6 +183,13 @@ def legs_for_changes(files) -> set:
             continue
         if path.startswith("ml_trainer_tpu/serving/"):
             return set(ALL_LEGS)
+        if path.startswith("ml_trainer_tpu/ops/"):
+            # The kernel layer (and the ops it references) is covered by
+            # its own parity/identity/recompile gate plus the sharded-
+            # update matrix; the full 2700s sweep adds nothing an ops/
+            # edit can regress that these two don't measure.
+            legs.update({"kernels", "mixed"})
+            continue
         if path.startswith("ml_trainer_tpu/resilience/"):
             legs.update({"elastic", "overload", "fleet"})
             continue
@@ -1274,6 +1292,100 @@ def gate_elastic(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_kernels_reference(repo: str = REPO):
+    """Kernel-engine decode steps/s from the committed kernel-pass
+    artifact (docs/kernels_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "kernels_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = (data.get("decode") or {}).get("decode_steps_per_sec")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_kernels(threshold: float, backend: str, fp: str) -> dict:
+    """The ops/kernels/ Pallas-pass regression gate: a fresh run of the
+    kernel microbench + real-engine decode comparison, gated —
+
+    1. **Invariants** (hard): interpret-mode parity bit-for-bit for all
+       three kernels (paged attention, fused Adam tail, int8 matmul),
+       engine byte identity gather-vs-``paged_kernel`` across ragged
+       traffic, and zero post-warmup compiles in the steady compiled
+       decode loop.
+    2. **Ratio floor** (machine-independent): the paged_kernel decode
+       step holds >= 0.5x the gather engine's step rate — off-TPU both
+       dispatch the same reference program, so a real drop means the
+       kernel path grew work the gather path does not have.
+    3. **Trajectory/local baseline** on the kernel-engine decode
+       steps/s, with the calibrate-then-ratchet fallback the parity
+       gate uses (machine baseline key ``cpu_kernels``).
+    """
+    import bench
+
+    result = bench.bench_kernels()
+    kernels = result.get("kernels") or {}
+    decode = result.get("decode") or {}
+    out = {
+        "decode_steps_per_sec": decode.get("decode_steps_per_sec"),
+        "kernel_vs_gather": decode.get("kernel_vs_gather"),
+        "kernel_speedups": {
+            name: row.get("speedup") for name, row in kernels.items()
+        },
+        "threshold": threshold,
+    }
+    parity_fails = [
+        name for name, row in kernels.items()
+        if not (row.get("interpret_parity")
+                or row.get("trajectory_parity"))
+    ]
+    if len(kernels) < 3 or parity_fails:
+        out.update(ok=False, decided_by="parity",
+                   error="interpret-mode parity broken for: "
+                   + (", ".join(parity_fails) or "missing kernel rows"))
+        return out
+    if not decode.get("byte_identical"):
+        out.update(ok=False, decided_by="identity",
+                   error="paged_kernel engine output diverged from the "
+                   "gather+flash engine")
+        return out
+    if decode.get("post_warmup_compiles") != 0:
+        out.update(ok=False, decided_by="zero_recompile",
+                   error=f"{decode.get('post_warmup_compiles')} "
+                   "compile(s) after warmup in the steady decode loop")
+        return out
+    ratio = float(decode.get("kernel_vs_gather") or 0.0)
+    if ratio < 0.5:
+        out.update(
+            ok=False, decided_by="ratio_floor",
+            error=f"paged_kernel decode step is {ratio}x the gather "
+            "engine's rate — below the 0.5x floor (same reference "
+            "program off-TPU; the kernel path grew extra work)",
+        )
+        return out
+    committed = committed_kernels_reference()
+    kern_key = f"{backend}_kernels"
+    baseline = load_baseline(kern_key, fp)
+    fresh = float(decode.get("decode_steps_per_sec") or 0.0)
+    decision = evaluate(
+        fresh, committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(kern_key, fp, max(fresh, baseline or 0.0))
+    elif "error" not in out:
+        out["error"] = (
+            f"kernel-engine decode {fresh} steps/s is "
+            f">{threshold * 100:.0f}% below this machine's baseline "
+            f"{baseline}"
+        )
+    return out
+
+
 def committed_lint_baseline(repo: str = REPO):
     """The committed graft-lint baseline artifact, or None."""
     path = os.path.join(repo, "docs", "graft_lint_baseline.json")
@@ -1385,6 +1497,11 @@ def main() -> int:
                         "recompile gate")
     parser.add_argument("--skip-lint", action="store_true",
                         help="skip the graft-lint static-analysis gate")
+    parser.add_argument("--skip-kernels", action="store_true",
+                        help="skip the ops/kernels/ Pallas-pass gate "
+                        "(interpret parity + engine byte identity + "
+                        "zero-recompile invariants, decode steps/s "
+                        "ratchet vs docs/kernels_cpu.json)")
     parser.add_argument("--skip-elastic", action="store_true",
                         help="skip the elastic-training chaos gate")
     parser.add_argument("--skip-fleet", action="store_true",
@@ -1595,6 +1712,20 @@ def main() -> int:
             f"{ela['steps_lost_hard_kill']} step(s) (bound "
             f"{ela['steps_lost_bound']}), recovered in "
             f"{ela['time_to_recover_secs']}s",
+            flush=True,
+        )
+    if not args.skip_kernels and "kernels" in selected:
+        kern = gate_kernels(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_kernels": kern}), flush=True)
+        if not kern["ok"]:
+            print(f"BENCH_GATE KERNELS FAIL: {kern.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE KERNELS OK ({kern['decided_by']}): "
+            f"{kern['decode_steps_per_sec']} decode steps/s "
+            f"({kern['kernel_vs_gather']}x gather engine), parity + "
+            "identity + zero-recompile pinned",
             flush=True,
         )
     if not args.skip_lint and "lint" in selected:

@@ -76,7 +76,16 @@
 # respawning a real replacement process) backed by the fleet gate
 # (bench_gate.py gate_fleet: identity/zero-recompile/chunk-coverage/
 # chaos-recovery invariants hard, fleet tokens/s ratchet vs
-# docs/serving_fleet_cpu.json; --skip-fleet to skip).
+# docs/serving_fleet_cpu.json; --skip-fleet to skip), and a Pallas
+# kernel-layer smoke leg (scripts/kernels_smoke.py: interpret-mode
+# bit parity for the paged-attention / fused-Adam / int8-matmul
+# kernels vs their lax references, real-Server byte identity gather
+# vs paged_kernel with zero post-warmup recompiles, fused-vs-optax
+# sharded-Adam bit-identical trainer golden, structured refusals, and
+# the int8 argmax-agreement quality gate) backed by the kernels gate
+# (bench_gate.py gate_kernels: parity/identity/zero-recompile
+# invariants hard, kernel-vs-gather ratio floor, decode steps/s
+# ratchet vs docs/kernels_cpu.json; --skip-kernels to skip).
 #
 # On a PR branch (HEAD != origin/main with origin/main resolvable) the
 # bench gate runs in --changed-only mode: the diff's files map to gate
@@ -144,6 +153,10 @@ echo "# multi-process serving-fleet smoke leg"
 timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 fleet_rc=$?
 [ $fleet_rc -ne 0 ] && echo "# fleet smoke FAILED (rc=$fleet_rc)"
+echo "# Pallas kernel-layer smoke leg"
+timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/kernels_smoke.py
+kernels_rc=$?
+[ $kernels_rc -ne 0 ] && echo "# kernels smoke FAILED (rc=$kernels_rc)"
 echo "# graft-lint static-analysis leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/graft_lint.py
 lint_rc=$?
@@ -185,6 +198,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$overload_rc
 [ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$fleet_rc
+[ $rc -eq 0 ] && rc=$kernels_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc
 [ $rc -eq 0 ] && rc=$gate_rc

@@ -13,7 +13,15 @@ and how much the MXU fills as the batch grows — the evidence VERDICT r3
 item 2 asks for.  Writes docs/resnet50_mfu_ledger.json and prints one
 line per row.
 
+Beside the analytic cross-check, the ledger now carries per-kernel
+before/after columns from the ``ops/kernels/`` microbench artifact
+(``docs/kernels_cpu.json``, regenerated with ``bench.py --kernels``):
+reference-vs-fused microseconds and parity per kernel, so the roofline
+rows and the kernel-level wins land in one document.  ``--kernels-only``
+prints just that table (no chip needed).
+
     python scripts/mfu_ledger.py [--model resnet50] [--batches 32,128,256]
+    python scripts/mfu_ledger.py --kernels-only
 """
 
 import argparse
@@ -161,11 +169,77 @@ def measure(model_name: str, batch: int) -> dict:
     return row
 
 
+def kernel_columns(path=None):
+    """Per-kernel before/after columns from the ``ops/kernels/``
+    microbench artifact (``bench.py --kernels``): one row per kernel —
+    reference (pre-kernel program) vs fused dispatch microseconds, the
+    speedup, and the bit-parity pin — plus the engine-level decode
+    step-time pair.  Returns None when the artifact is absent."""
+    path = path or os.path.join(ROOT, "docs", "kernels_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    rows = {}
+    for name, row in (data.get("kernels") or {}).items():
+        rows[name] = {
+            "before_us": row.get("reference_us"),
+            "after_us": row.get("kernel_us"),
+            "speedup": row.get("speedup"),
+            "parity": bool(
+                row.get("interpret_parity") or row.get("trajectory_parity")
+            ),
+        }
+    decode = data.get("decode") or {}
+    return {
+        "artifact": os.path.basename(path),
+        "measured_backend": data.get("backend"),
+        "rows": rows,
+        "decode_step": {
+            "before_us": decode.get("gather_step_us"),
+            "after_us": decode.get("kernel_step_us"),
+            "speedup": decode.get("kernel_vs_gather"),
+        },
+        "note": data.get("note"),
+    }
+
+
+def print_kernel_columns(cols) -> None:
+    if not cols:
+        print("# kernels: no docs/kernels_cpu.json — run "
+              "`python bench.py --kernels` first", flush=True)
+        return
+    for name, row in cols["rows"].items():
+        print(
+            f"# kernel {name:>16} before {row['before_us']:>9,.1f} us  "
+            f"after {row['after_us']:>9,.1f} us  x{row['speedup']:.2f}  "
+            f"parity={'ok' if row['parity'] else 'BROKEN'}  "
+            f"({cols['measured_backend']})", flush=True,
+        )
+    d = cols["decode_step"]
+    if d.get("before_us"):
+        print(
+            f"# kernel {'decode_step':>16} before {d['before_us']:>9,.1f}"
+            f" us  after {d['after_us']:>9,.1f} us  x{d['speedup']:.2f}  "
+            f"(real engine)", flush=True,
+        )
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet50")
     ap.add_argument("--batches", default="32,128,256")
+    ap.add_argument("--kernels-artifact", default=None, metavar="PATH",
+                    help="kernel microbench artifact to read (default "
+                    "docs/kernels_cpu.json)")
+    ap.add_argument("--kernels-only", action="store_true",
+                    help="print only the per-kernel before/after columns "
+                    "from the kernels artifact and exit (no chip needed)")
     args = ap.parse_args()
+    kernels = kernel_columns(args.kernels_artifact)
+    if args.kernels_only:
+        print_kernel_columns(kernels)
+        sys.exit(0 if kernels else 1)
     from ml_trainer_tpu.utils.tunnel import acquire_tunnel_lock
 
     if not acquire_tunnel_lock(time.time() + 300.0, [],
@@ -179,9 +253,14 @@ def main():
         row = measure(args.model, b)
         rows.append(row)
         print(json.dumps(row), flush=True)
+    print_kernel_columns(kernels)
     out = os.path.join(ROOT, "docs", f"{args.model}_mfu_ledger.json")
     with open(out, "w") as fp:
-        json.dump({"device": str(jax.devices()[0]), "rows": rows}, fp, indent=1)
+        json.dump(
+            {"device": str(jax.devices()[0]), "rows": rows,
+             "kernels": kernels},
+            fp, indent=1,
+        )
     print(f"-> {out}")
 
 

@@ -1,0 +1,417 @@
+"""Pallas kernel layer (ml_trainer_tpu/ops/kernels/).
+
+Every kernel ships pinned to a lax reference: the Pallas body run in
+interpret mode must equal the reference BIT-FOR-BIT on CPU (both sides
+under jit — the mode every caller runs in; eager-vs-traced differs by
+FMA fusion noise no real path sees).  On top of the kernel-level pins:
+the real Server streams identical bytes with ``paged_kernel`` on/off,
+the real Trainer walks a bit-identical trajectory with the fused Adam
+tail on/off, opt-in knobs refuse unsupported configs up front, and the
+int8 decode path clears the argmax-agreement quality gate on a
+peaked-logit model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ml_trainer_tpu.models import get_model
+from ml_trainer_tpu.ops.kernels import (
+    adam_scalars,
+    fused_adam_update,
+    int8_matmul,
+    paged_attention,
+    paged_attention_reference,
+    quantize_per_channel,
+    quantize_tree,
+    unscale_sqsum,
+)
+
+
+def _jrun(fn, *args, **kw):
+    return jax.jit(lambda *a: fn(*a, **kw))(*args)
+
+
+def _bits_equal(a, b):
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b))
+    )
+
+
+def _paged_case(rng, b, h, d, ps, P, dtype, lengths):
+    n_pages = b * P + 1  # + trash page 0
+    q = jnp.asarray(rng.normal(size=(b, h, d)) * 0.5, dtype)
+    kp, vp = (
+        jnp.asarray(rng.normal(size=(n_pages, h, ps, d)) * 0.5, dtype)
+        for _ in range(2)
+    )
+    table = jnp.asarray(
+        1 + rng.permutation(n_pages - 1).reshape(b, P), jnp.int32
+    )
+    return q, kp, vp, table, jnp.asarray(lengths, jnp.int32)
+
+
+# --------------------------------------------------- kernel-level pins
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "b,h,d,ps,P",
+    [(2, 2, 8, 8, 2), (3, 4, 32, 16, 4)],  # VPU-lane and MXU-ish buckets
+)
+def test_paged_attention_interpret_parity(dtype, b, h, d, ps, P):
+    """Ragged lengths — full row, length-1 (trash-page reads masked),
+    partial last page — bit-equal to the gather reference."""
+    rng = np.random.default_rng(0)
+    lengths = [ps * P, 1, ps + 1][:b] + [ps * P] * max(0, b - 3)
+    q, kp, vp, table, ln = _paged_case(rng, b, h, d, ps, P, dtype, lengths)
+    got = _jrun(paged_attention, q, kp, vp, table, ln,
+                implementation="pallas", interpret=True)
+    want = _jrun(paged_attention_reference, q, kp, vp, table, ln)
+    assert got.dtype == want.dtype
+    assert _bits_equal(got, want)
+
+
+def test_paged_attention_chain_fills_table():
+    """Every non-trash page referenced exactly once (the pool exactly
+    sized, nothing spare) and an all-trash table row: the mask, not the
+    table contents, must decide what contributes."""
+    rng = np.random.default_rng(1)
+    q, kp, vp, table, ln = _paged_case(
+        rng, 4, 2, 16, 8, 3, jnp.float32, [24, 24, 24, 1]
+    )
+    # Row 3 reads only token 0 of its first page; point the REST of its
+    # row at the trash page — contents must not matter.
+    table = table.at[3, 1:].set(0)
+    got = _jrun(paged_attention, q, kp, vp, table, ln,
+                implementation="pallas", interpret=True)
+    want = _jrun(paged_attention_reference, q, kp, vp, table, ln)
+    assert _bits_equal(got, want)
+
+
+@pytest.mark.parametrize(
+    "shape", [(7,), (128,), (3, 5), (64, 16), (2, 3, 4)]
+)
+def test_unscale_sqsum_shape_sweep(shape):
+    """The division matches bitwise and the square-sum reduces in the
+    reference's association order — including multi-axis leaves, whose
+    per-axis reduction is shape-sensitive."""
+    rng = np.random.default_rng(2)
+    g = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    for denom in (2.0, jnp.float32(8.0)):
+        for compute_sq in (True, False):
+            got = _jrun(unscale_sqsum, g, denom, compute_sq=compute_sq,
+                        implementation="pallas", interpret=True)
+            want = _jrun(unscale_sqsum, g, denom, compute_sq=compute_sq,
+                         implementation="reference")
+            assert _bits_equal(got, want)
+            assert (got[1] is None) == (not compute_sq)
+
+
+def test_fused_adam_trajectory_matches_optax():
+    """8 jitted steps of the fused tail (unscale -> global clip ->
+    adam_scalars -> fused_adam_update -> opt_state rebuild) vs the
+    unfused optax chain: params AND opt_state bit-identical at every
+    step, so checkpoints are interchangeable mid-run."""
+    shapes = {"w": (32, 16), "b": (16,), "emb": (64, 8)}
+    keys = jax.random.split(jax.random.PRNGKey(4), len(shapes) + 1)
+    params = {
+        n: jax.random.normal(k, s, jnp.float32) * 0.02
+        for (n, s), k in zip(shapes.items(), keys)
+    }
+    lr, clip, denom = 1e-2, 1.0, 4.0
+
+    def sched(_count):
+        return jnp.asarray(lr, jnp.float32)
+
+    tx = optax.chain(optax.identity(), optax.adam(sched))
+    one = jnp.asarray(1.0, jnp.float32)
+
+    @jax.jit
+    def ref_tail(g, p, st):
+        g = jax.tree.map(lambda t: t / denom, g)
+        sq = sum(
+            jnp.sum(jnp.square(t.astype(jnp.float32)))
+            for t in jax.tree.leaves(g)
+        )
+        factor = clip / jnp.maximum(jnp.sqrt(sq), clip)
+        g = jax.tree.map(lambda t: t * factor, g)
+        updates, new_st = tx.update(g, st, p)
+        return optax.apply_updates(p, updates), new_st
+
+    @jax.jit
+    def fused_tail(g, p, st):
+        _e, (adam_st, sched_st) = st
+        g_def = jax.tree.structure(g)
+        gs, sq = [], 0.0
+        for t in jax.tree.leaves(g):
+            th, s = unscale_sqsum(t, denom, compute_sq=True)
+            gs.append(th)
+            sq = sq + s
+        factor = clip / jnp.maximum(jnp.sqrt(sq), clip)
+        count_inc, bc1, bc2, step_size, sched_inc = adam_scalars(
+            adam_st.count, sched_st.count, sched
+        )
+        outs = [
+            fused_adam_update(t, pv, mu, nu, bc1=bc1, bc2=bc2,
+                              step_size=step_size, lr_scale=one,
+                              factor=factor)
+            for t, pv, mu, nu in zip(
+                gs, jax.tree.leaves(p),
+                jax.tree.leaves(adam_st.mu), jax.tree.leaves(adam_st.nu),
+            )
+        ]
+        new_p = jax.tree.unflatten(g_def, [o[0] for o in outs])
+        new_st = (optax.EmptyState(), (
+            optax.ScaleByAdamState(
+                count=count_inc,
+                mu=jax.tree.unflatten(g_def, [o[1] for o in outs]),
+                nu=jax.tree.unflatten(g_def, [o[2] for o in outs]),
+            ),
+            optax.ScaleByScheduleState(count=sched_inc),
+        ))
+        return new_p, new_st
+
+    p_ref = p_fused = params
+    st_ref = st_fused = tx.init(params)
+    for step in range(8):
+        grads = {
+            n: jax.random.normal(
+                jax.random.fold_in(keys[-1], step * 10 + i), s,
+                jnp.float32,
+            )
+            for i, (n, s) in enumerate(shapes.items())
+        }
+        p_ref, st_ref = ref_tail(grads, p_ref, st_ref)
+        p_fused, st_fused = fused_tail(grads, p_fused, st_fused)
+        assert _bits_equal(p_ref, p_fused), f"params diverged at {step}"
+        assert _bits_equal(st_ref, st_fused), f"state diverged at {step}"
+
+
+def test_int8_matmul_parity_and_quantize():
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(32, 48)) * 0.1, jnp.float32)
+    w = w.at[:, 0].set(0.0)  # all-zero column: scale must stay finite
+    w_q, scale = quantize_per_channel(w)
+    assert w_q.dtype == jnp.int8 and scale.shape == (48,)
+    assert np.all(np.asarray(scale) > 0)
+    # Symmetric per-channel round-trip: within half a quantization step.
+    err = np.abs(np.asarray(w) - np.asarray(w_q, np.float32) * scale)
+    assert np.all(err <= np.asarray(scale) * 0.5 + 1e-7)
+    got = _jrun(int8_matmul, x, w_q, scale, implementation="pallas",
+                interpret=True)
+    want = _jrun(int8_matmul, x, w_q, scale, implementation="reference")
+    assert _bits_equal(got, want)
+    with pytest.raises(ValueError, match="int8"):
+        int8_matmul(x, w.astype(jnp.float32), scale)
+
+
+def test_quantize_tree_structure():
+    model = get_model("gpt2_tiny", max_len=32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    quant = quantize_tree(variables["params"])
+
+    def leaf_keys(d, out):
+        for k, v in d.items():
+            (leaf_keys(v, out) if isinstance(v, dict) else out.add(k))
+        return out
+
+    names = leaf_keys(quant, set())
+    # Every target contributed its w/scale/b triple somewhere.
+    for t in ("qkv", "proj", "fc_in", "fc_out"):
+        assert {f"{t}_w", f"{t}_scale", f"{t}_b"} <= names, names
+    # Nothing matched -> {} (callers refuse, never serve unquantized).
+    assert quantize_tree(variables["params"], targets=("nope",)) == {}
+    with pytest.raises(TypeError):
+        quantize_tree([1, 2, 3])
+
+
+# ------------------------------------------------ engine + trainer pins
+@pytest.fixture(scope="module")
+def model_and_vars():
+    model = get_model("gpt2_tiny", max_len=64)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, np.zeros((1, 8), np.int32),
+        train=False,
+    )
+    return model, variables
+
+
+def _prompt(seed, n):
+    return np.asarray(
+        np.random.default_rng(seed).integers(0, 1024, n), np.int32
+    )
+
+
+def _run_requests(model, variables, **server_kw):
+    from ml_trainer_tpu.serving import Server
+
+    prompts = [_prompt(s, n) for s, n in
+               ((0, 5), (1, 3), (2, 12), (3, 7), (4, 17), (5, 9))]
+    outs = []
+    with Server(model, variables, max_batch=4, kv_page_size=16,
+                **server_kw) as server:
+        streams = [
+            server.submit(p, 10, temperature=0.7, rng=42)
+            if i == 3 else server.submit(p, 10)
+            for i, p in enumerate(prompts)
+        ]
+        for s in streams:
+            outs.append(np.asarray(s.result(timeout=300)))
+    return outs
+
+
+def test_server_paged_kernel_byte_identity(model_and_vars):
+    """The fused-gather decode program streams the same bytes as the
+    gather+flash program across ragged join/leave traffic, and its
+    steady-state decode loop compiles nothing."""
+    from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+    from ml_trainer_tpu.telemetry import compile_watch
+
+    model, variables = model_and_vars
+    base = _run_requests(model, variables, paged_kernel=False)
+    paged = _run_requests(model, variables, paged_kernel=True)
+    for a, b in zip(base, paged):
+        np.testing.assert_array_equal(a, b)
+
+    eng = SlotDecodeEngine(model, variables, max_batch=4,
+                           kv_page_size=16, paged_kernel=True)
+    cache, tok = eng.cache, eng.tok
+    for _ in range(2):  # warmup builds the decode program
+        cache, tok = eng._decode(
+            eng.params, cache, tok, eng._temps, eng._rngs, eng._steps
+        )
+    jax.block_until_ready(tok)
+    with compile_watch.expect_no_compiles("paged_kernel decode loop"):
+        for _ in range(6):
+            cache, tok = eng._decode(
+                eng.params, cache, tok, eng._temps, eng._rngs,
+                eng._steps,
+            )
+        jax.block_until_ready(tok)
+
+
+def test_server_quant_int8_serves_deterministically(model_and_vars):
+    """The int8 decode program is a different program (different bytes
+    are fine — quantization changes the math) but a stable one: two
+    identical runs stream identical bytes."""
+    model, variables = model_and_vars
+    a = _run_requests(model, variables, quant_int8=True)
+    b = _run_requests(model, variables, quant_int8=True)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_kernel_knob_refusals(model_and_vars):
+    from ml_trainer_tpu.serving.engine import SlotDecodeEngine
+
+    model, variables = model_and_vars
+    with pytest.raises(ValueError, match="paged_kernel needs paged KV"):
+        SlotDecodeEngine(model, variables, max_batch=2, paged_kernel=True)
+    with pytest.raises(ValueError, match="spec_k"):
+        SlotDecodeEngine(model, variables, max_batch=2, kv_page_size=16,
+                         quant_int8=True, spec_k=2)
+    with pytest.raises(ValueError, match="adapters"):
+        SlotDecodeEngine(model, variables, max_batch=2, kv_page_size=16,
+                         quant_int8=True, adapters=object())
+
+
+def test_trainer_fused_adam_refusals(tmp_path):
+    from ml_trainer_tpu import Trainer
+    from ml_trainer_tpu.data import SyntheticTokens
+
+    ds = SyntheticTokens(size=32, seq_len=32, vocab_size=256, seed=0)
+    common = dict(datasets=(ds, ds), epochs=1, batch_size=16,
+                  metric=None, backend="cpu")
+    with pytest.raises(ValueError, match="dp_update='sharded'"):
+        Trainer(get_model("gpt2_tiny", vocab_size=256),
+                model_dir=str(tmp_path / "a"), fused_adam=True,
+                optimizer="adam", **common)
+    with pytest.raises(ValueError, match="optimizer='adam'"):
+        Trainer(get_model("gpt2_tiny", vocab_size=256),
+                model_dir=str(tmp_path / "b"), fused_adam=True,
+                optimizer="adamw", is_parallel=True,
+                dp_update="sharded", **common)
+    with pytest.raises(ValueError, match="weight_decay"):
+        Trainer(get_model("gpt2_tiny", vocab_size=256),
+                model_dir=str(tmp_path / "c"), fused_adam=True,
+                optimizer="adam", weight_decay=0.1, is_parallel=True,
+                dp_update="sharded", **common)
+
+
+def test_trainer_fused_adam_golden_and_checkpoint_roundtrip(tmp_path):
+    """sharded+adam auto-enables the fused tail; the trajectory — every
+    loss AND every param bit — is identical to the unfused optax tail,
+    one compiled program, and the fused run's state round-trips through
+    the v2 checkpoint format unchanged (opt_state layout untouched)."""
+    from ml_trainer_tpu import Trainer
+    from ml_trainer_tpu.checkpoint import checkpoint as ckpt
+    from ml_trainer_tpu.data import SyntheticTokens
+
+    ds = SyntheticTokens(size=64, seq_len=32, vocab_size=256, seed=0)
+    common = dict(
+        datasets=(ds, ds), epochs=2, batch_size=16, seed=3, lr=0.01,
+        optimizer="adam", metric=None, is_parallel=True, backend="cpu",
+        dp_update="sharded",
+    )
+    t_ref = Trainer(get_model("gpt2_tiny", vocab_size=256),
+                    model_dir=str(tmp_path / "ref"), fused_adam=False,
+                    **common)
+    assert not t_ref.fused_adam
+    t_ref.fit()
+    t_fused = Trainer(get_model("gpt2_tiny", vocab_size=256),
+                      model_dir=str(tmp_path / "fused"), **common)
+    assert t_fused.fused_adam  # None -> auto: eligible config
+    t_fused.fit()
+    assert t_fused._train_step._cache_size() == 1
+    assert t_ref.train_losses == t_fused.train_losses
+    assert _bits_equal(t_ref.state.params, t_fused.state.params)
+    assert _bits_equal(t_ref.state.opt_state, t_fused.state.opt_state)
+
+    path = ckpt.save_checkpoint(
+        str(tmp_path / "ckpt"), t_fused.state, {"train_loss": []}, epoch=2
+    )
+    restored, _, _ = ckpt.restore_checkpoint(path, t_ref.state)
+    assert _bits_equal(t_fused.state.params, restored.params)
+    assert _bits_equal(t_fused.state.opt_state, restored.opt_state)
+
+
+def test_int8_quality_gate(tmp_path):
+    """Argmax agreement >= 99.5% with bounded relative logit error on a
+    model with real top-1 margins: gpt2_tiny memorizes a deterministic
+    successor map in 4 epochs (random next-token targets leave logits
+    near-tied, which measures tie-breaking, not the kernel)."""
+    from ml_trainer_tpu import Trainer
+    from ml_trainer_tpu.data.datasets import ArrayDataset
+
+    rng = np.random.default_rng(0)
+    V, S, N = 64, 32, 64
+    succ = rng.permutation(V)
+    data = np.zeros((N, S), np.int32)
+    data[:, 0] = rng.integers(0, V, N)
+    for t in range(1, S):
+        data[:, t] = succ[data[:, t - 1]]
+    model = get_model("gpt2_tiny", vocab_size=V)
+    trainer = Trainer(
+        model,
+        datasets=(ArrayDataset(data, np.roll(data, -1, axis=1), None),) * 2,
+        model_dir=str(tmp_path / "q"), epochs=4, batch_size=16, seed=3,
+        lr=0.01, optimizer="adamw", metric=None, backend="cpu",
+    )
+    trainer.fit()
+    params = trainer.state.params
+    toks = jnp.asarray(data[:8])
+    lf = model.apply({"params": params}, toks, train=False)
+    lq = model.clone(quant_int8=True).apply(
+        {"params": params, "quant": quantize_tree(params)}, toks,
+        train=False,
+    )
+    agreement = float((jnp.argmax(lf, -1) == jnp.argmax(lq, -1)).mean())
+    rel_err = float(jnp.max(jnp.abs(lf - lq)) / jnp.max(jnp.abs(lf)))
+    assert agreement >= 0.995, f"argmax agreement {agreement}"
+    assert rel_err <= 0.02, f"relative logit error {rel_err}"
