@@ -1563,6 +1563,403 @@ def bench_serve_fleet(n_requests=32, n_tenants=2, long_frac=0.4,
     return result
 
 
+def bench_serve_deploy(n_requests=24, n_tenants=8, mean_interarrival=0.12,
+                       page_size=8, max_batch=4, seed=0,
+                       ttft_ms=2000.0, tpot_ms=2000.0, wedge_s=3.0,
+                       out_path=None):
+    """Live base-model rollout on a multi-process fleet
+    (serving/deploy.py, docs/serving.md "Deploys"): train a tiny gpt2
+    in-bench, export it (manifest + weights fingerprint), then roll a
+    live 2-process fleet onto the export UNDER OPEN-LOOP TRAFFIC.  Two
+    legs, one committed artifact:
+
+    * **deploy** — the fleet serves the seed init while a background
+      client replays a seeded trace open-loop in a loop;
+      ``Router.deploy(ckpt, canary=0.25)`` spawns new-generation
+      worker PROCESSES loaded from the export (shared on-disk compile
+      cache), warms them off-path, routes the tenant-hash canary slice
+      at them, holds clean burn, ramps to 100% and retires the old
+      workers — all while the client sees ZERO errors (no dropped
+      streams) and every mid-deploy output is byte-identical to
+      ``generate()`` on whichever weights its generation serves.  The
+      old steady fleet's per-process compile counts (polled via
+      ``/v1/spec`` until retirement) must not move during the deploy.
+    * **rollback** — the SAME export deployed again (gen2 == gen1
+      weights, so every output stays byte-checkable) through a wedged
+      factory whose ``submit_request`` sleeps ``wedge_s`` — an honest
+      TTFT regression on exactly the canary slice.  The burn watch
+      trips, the deployment rolls back within one burn window, the
+      fleet lands back on its pre-deploy replica set, and the client
+      again sees zero errors and byte-identical outputs throughout.
+
+    A final timed pass on the post-rollback fleet pins zero
+    post-warmup recompiles + byte identity and is the throughput
+    number ``gate_deploy`` ratchets."""
+    import os
+    import shutil
+    import tempfile
+    import threading
+
+    from ml_trainer_tpu import Trainer
+    from ml_trainer_tpu.checkpoint import (
+        load_model_manifest, load_model_variables,
+    )
+    from ml_trainer_tpu.data import SyntheticTokens
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving import DeployConfig, SloPolicy
+    from ml_trainer_tpu.serving.fleet import Fleet
+    from ml_trainer_tpu.serving.loadgen import (
+        ScheduledRequest, run_open_loop, schedule_from_trace,
+        schedule_to_records,
+    )
+    from ml_trainer_tpu.generate import generate
+
+    max_len = 64
+    model = get_model("gpt2_tiny", max_len=max_len)
+    rng = np.random.default_rng(seed)
+    work_dir = tempfile.mkdtemp(prefix="bench_deploy_")
+    ckpt_dir = os.path.join(work_dir, "export")
+
+    # The rollout target: a REAL export of a REAL (tiny) training run,
+    # manifest + weights fingerprint included.
+    ds = SyntheticTokens(size=32, seq_len=16,
+                         vocab_size=model.vocab_size, seed=0)
+    Trainer(model, datasets=(ds, ds), epochs=1, batch_size=8,
+            metric=None, model_dir=ckpt_dir, seed=7, lr=0.01).fit()
+    manifest = load_model_manifest(ckpt_dir) or {}
+    trained = load_model_variables(ckpt_dir)
+    # Workers spawned WITHOUT --ckpt init from PRNGKey(seed=0) — the
+    # driver-side twin of the old generation's weights.
+    seed_vars = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+
+    policy = SloPolicy(ttft_ms=ttft_ms, tpot_ms=tpot_ms, target=0.9)
+    kv_pages = 3 * max_batch * (max_len // page_size) + 1
+    fleet = Fleet(
+        roles=["both", "both"], model_name="gpt2_tiny", max_len=max_len,
+        max_batch=max_batch, max_queue=2 * n_requests,
+        kv_page_size=page_size, kv_pages=kv_pages, seed=0,
+        # Prefix cache off so looped replays genuinely re-prefill and
+        # stay byte-comparable; hedging off so placement (and thus
+        # which generation serves a mid-deploy request) follows the
+        # tenant-hash split deterministically.
+        prefix_cache=False,
+    )
+    fleet.start()
+    router = fleet.make_router(
+        slo=policy, slo_timelines=8 * n_requests, hedging=False,
+    )
+    result = {}
+    try:
+        host, port = router.serve_http(port=0)
+        url = f"http://{host}:{port}"
+
+        # Tenants chosen so the 0.25 canary slice holds exactly 2 of
+        # the 8 — a stable cohort with traffic on BOTH sides of the
+        # split every pass.
+        canary_pool = [t for t in (f"t{i}" for i in range(64))
+                       if router.tenant_slice(t) < 0.25][:2]
+        stable_pool = [t for t in (f"t{i}" for i in range(64))
+                       if router.tenant_slice(t) >= 0.25][:n_tenants - 2]
+        tenants = (canary_pool + stable_pool)
+
+        rows = []
+        for i in range(n_requests):
+            n = int(rng.integers(8, 17))
+            rows.append(ScheduledRequest(
+                arrival_s=float(i * mean_interarrival),
+                tenant=tenants[i % len(tenants)],
+                prompt=rng.integers(
+                    0, model.vocab_size, n
+                ).astype(np.int32),
+                max_new_tokens=8,
+            ))
+        trace = schedule_from_trace(schedule_to_records(rows))
+        refs_seed = [
+            [int(t) for t in np.asarray(
+                generate(model, seed_vars, s.prompt[None],
+                         s.max_new_tokens)
+            )[0]]
+            for s in trace
+        ]
+        refs_trained = [
+            [int(t) for t in np.asarray(
+                generate(model, trained, s.prompt[None],
+                         s.max_new_tokens)
+            )[0]]
+            for s in trace
+        ]
+
+        def live_compiles():
+            out = {}
+            for rep in list(router.replicas.values()):
+                try:
+                    out[rep.name] = int(
+                        rep.server._get("/v1/spec")["compiles"] or 0
+                    )
+                except Exception:
+                    pass
+            return out
+
+        class _Poller:
+            """Samples every live replica's compile count until
+            stopped — old-generation workers are retired (processes
+            gone) at promote, so their final counts must be caught
+            in flight."""
+
+            def __init__(self):
+                self.last_seen = {}
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self.last_seen.update(live_compiles())
+                    self._stop.wait(0.2)
+
+            def __enter__(self):
+                self._thread.start()
+                return self
+
+            def __exit__(self, *exc):
+                self._stop.set()
+                self._thread.join(timeout=5.0)
+
+        class _Load:
+            """Open-loop client looping the trace until stopped."""
+
+            def __init__(self):
+                self.passes = []
+                self._stop = threading.Event()
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True)
+
+            def _run(self):
+                while not self._stop.is_set():
+                    self.passes.append(run_open_loop(
+                        trace, url=url, collect_tokens=True))
+
+            def __enter__(self):
+                self._thread.start()
+                return self
+
+            def __exit__(self, *exc):
+                self._stop.set()
+                self._thread.join(timeout=600.0)
+
+            def n_errors(self):
+                return sum(p["n_errors"] for p in self.passes)
+
+            def outputs_ok(self, allowed_refs):
+                for p in self.passes:
+                    for i, r in enumerate(p["per_request"]):
+                        if not any(r.get("output") == refs[i]
+                                   for refs in allowed_refs):
+                            return False
+                return bool(self.passes)
+
+        for _ in range(2):  # untimed: workers compile to steady state
+            run_open_loop(trace, url=url, time_scale=0.0)
+
+        cfg = DeployConfig(
+            canary=0.25, stages=(1.0,), hold_s=1.5,
+            burn_threshold=2.0, high_polls=2, window_s=10.0,
+            min_window_requests=2, stage_min_requests=2,
+            poll_interval_s=0.3, drain_timeout_s=60.0,
+        )
+
+        def deploy_leg(mode, factory, allowed_refs):
+            pre_replicas = sorted(router.replicas)
+            base = live_compiles()
+            t0 = time.monotonic()
+            with _Poller() as poller, _Load() as load:
+                dep = router.deploy(ckpt_dir, canary=cfg.canary,
+                                    factory=factory, config=cfg)
+                verdict = dep.wait(timeout=600.0)
+                elapsed = round(time.monotonic() - t0, 3)
+                dep.close()
+            steady = {
+                n: poller.last_seen[n] - base[n]
+                for n in base if n in poller.last_seen
+            }
+            rep = dep.report()
+            first_burn = next(
+                (e["t"] for e in rep["events"]
+                 if e["action"] == "burn_high"), None,
+            )
+            rolled_back_t = next(
+                (e["t"] for e in rep["events"]
+                 if e["action"] == "transition"
+                 and e.get("to") == "rolled_back"), None,
+            )
+            rollback_s = (
+                round(rolled_back_t - first_burn, 3)
+                if first_burn is not None and rolled_back_t is not None
+                else None
+            )
+            row = {
+                "mode": mode,
+                "state": verdict,
+                "deploy_s": elapsed,
+                "weights_fp": rep["weights_fp"],
+                "old_weights_fp": rep["old_weights_fp"],
+                "last_burn": rep["last_burn"],
+                "rollback_cause": rep["rollback_cause"],
+                "rollback_s": rollback_s,
+                "n_client_passes": len(load.passes),
+                "n_client_errors": load.n_errors(),
+                "byte_identical": load.outputs_ok(allowed_refs),
+                "steady_fleet_compiles": steady,
+                "zero_steady_recompiles": all(
+                    v == 0 for v in steady.values()),
+                "replicas_before": pre_replicas,
+                "replicas_after": sorted(router.replicas),
+                "events": [
+                    {k: e[k] for k in ("t", "action", "state")}
+                    for e in rep["events"]
+                ],
+            }
+            print(
+                f"# serve deploy [{mode:>9}]: {verdict} in "
+                f"{elapsed:.1f}s, {len(load.passes)} client pass(es), "
+                f"{row['n_client_errors']} error(s)"
+                + (f", rollback {rollback_s}s after first high burn"
+                   if rollback_s is not None else "")
+                + ("" if row["zero_steady_recompiles"]
+                   else "  [RECOMPILED]"),
+                flush=True,
+            )
+            return row
+
+        # Leg 1: healthy rollout mid-load.  Any mid-deploy output may
+        # come from either generation, so either reference is valid.
+        deploy_row = deploy_leg(
+            "deploy", fleet.deploy_factory(ckpt_dir),
+            (refs_seed, refs_trained),
+        )
+
+        # Leg 2: the SAME export again (gen2 weights == the now-serving
+        # gen1, so every output stays checkable against the trained
+        # refs) through a wedged factory — an honest canary-only TTFT
+        # regression the burn watch must catch.
+        base_factory = fleet.deploy_factory(ckpt_dir)
+
+        def wedged_factory(role):
+            remote = base_factory(role)
+            orig = remote.submit_request
+
+            def slow_submit(req):
+                time.sleep(wedge_s)
+                return orig(req)
+
+            remote.submit_request = slow_submit
+            return remote
+
+        rollback_row = deploy_leg(
+            "rollback", wedged_factory, (refs_trained,),
+        )
+
+        # Final timed pass on the post-rollback fleet: the promoted
+        # generation, steady, zero recompiles — the ratchet number.
+        before = live_compiles()
+        client = run_open_loop(trace, url=url, collect_tokens=True)
+        after = live_compiles()
+        fresh = {
+            n: after[n] - before[n] for n in after if n in before
+        }
+        final_row = {
+            "tokens_per_sec": client["tokens_per_sec"],
+            "makespan_s": client["makespan_s"],
+            "n_errors": client["n_errors"],
+            "byte_identical": all(
+                r.get("output") == ref
+                for r, ref in zip(client["per_request"], refs_trained)
+            ),
+            "worker_compiles_timed": fresh,
+            "zero_recompiles": all(v == 0 for v in fresh.values()),
+        }
+        print(
+            f"# serve deploy [    final]: "
+            f"{final_row['tokens_per_sec']:,.1f} tokens/s on the "
+            f"post-rollback fleet"
+            + ("" if final_row["zero_recompiles"] else "  [RECOMPILED]"),
+            flush=True,
+        )
+
+        result = {
+            "deploy": deploy_row,
+            "rollback": rollback_row,
+            "final": final_row,
+            "manifest_fingerprint": manifest.get("weights_fingerprint"),
+            "fingerprint_match": bool(
+                manifest.get("weights_fingerprint")
+                and deploy_row["weights_fp"]
+                == manifest["weights_fingerprint"]
+            ),
+            "rollback_within_window_s": cfg.window_s,
+            "n_requests": n_requests,
+            "n_tenants": n_tenants,
+            "wedge_s": wedge_s,
+            "seed": seed,
+            "backend": jax.default_backend(),
+        }
+        zero_errors = (
+            deploy_row["n_client_errors"] == 0
+            and rollback_row["n_client_errors"] == 0
+            and final_row["n_errors"] == 0
+        )
+        if deploy_row["state"] != "done":
+            result["error"] = (
+                f"healthy deploy ended {deploy_row['state']}, not done"
+            )
+        elif rollback_row["state"] != "rolled_back":
+            result["error"] = (
+                f"forced regression ended {rollback_row['state']}, "
+                "not rolled_back"
+            )
+        elif not zero_errors:
+            result["error"] = "client errors (dropped streams) observed"
+        elif not (deploy_row["byte_identical"]
+                  and rollback_row["byte_identical"]
+                  and final_row["byte_identical"]):
+            result["error"] = "fleet output diverged from generate()"
+        elif not (deploy_row["zero_steady_recompiles"]
+                  and rollback_row["zero_steady_recompiles"]
+                  and final_row["zero_recompiles"]):
+            result["error"] = (
+                "steady-fleet compiles observed during a deploy"
+            )
+        elif rollback_row["rollback_s"] is None or (
+                rollback_row["rollback_s"] > cfg.window_s):
+            result["error"] = (
+                f"rollback took {rollback_row['rollback_s']}s — "
+                f"outside the {cfg.window_s}s burn window"
+            )
+        elif rollback_row["replicas_after"] != (
+                rollback_row["replicas_before"]):
+            result["error"] = (
+                "rollback did not restore the pre-deploy replica set"
+            )
+        elif not result["fingerprint_match"]:
+            result["error"] = (
+                "served weights fingerprint != export manifest"
+            )
+    finally:
+        try:
+            router.close()
+        finally:
+            fleet.stop()
+            shutil.rmtree(work_dir, ignore_errors=True)
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# serve deploy artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_serve_chaos(n_requests=96, n_tenants=3, shared_frac=0.8,
                       mean_interarrival=0.04, shared_len=160,
                       page_size=16, max_batch=4, seed=0,
@@ -3404,6 +3801,17 @@ def main():
                         "zero per-process recompiles pinned; writes "
                         "docs/serving_fleet_cpu.json "
                         "(gpt2_tiny; CPU-safe)")
+    parser.add_argument("--serve-deploy", action="store_true",
+                        help="run only the live-rollout bench: train a "
+                        "tiny gpt2 in-bench, export it, and deploy the "
+                        "export onto a 2-process fleet MID-LOAD (canary "
+                        "-> ramp -> promote), then force a canary "
+                        "regression through a wedged factory and pin "
+                        "the SLO-burn auto-rollback; zero dropped "
+                        "streams, byte identity and zero steady-fleet "
+                        "recompiles pinned; writes "
+                        "docs/serving_deploy_cpu.json "
+                        "(gpt2_tiny; CPU-safe)")
     parser.add_argument("--serve-chaos", action="store_true",
                         help="run only the serving-chaos leg: the recorded "
                         "80%%-shared-prefix trace open-loop at saturating "
@@ -3608,6 +4016,22 @@ def main():
         )
         result = bench_serve_fleet(out_path=out)
         print(json.dumps({"serve_fleet": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.serve_deploy:
+        # Live base-model rollout under traffic: canary + auto-rollback
+        # on a real multi-process fleet; the artifact is the acceptance
+        # evidence for serving/deploy.py and feeds bench_gate.py
+        # gate_deploy.
+        import os as _os
+
+        out = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "serving_deploy_cpu.json",
+        )
+        result = bench_serve_deploy(out_path=out)
+        print(json.dumps({"serve_deploy": result}))
         if result.get("error"):
             sys.exit(1)
         return

@@ -29,6 +29,7 @@ remain readable.
 from __future__ import annotations
 
 import copy
+import hashlib
 import io
 import json
 import os
@@ -91,6 +92,78 @@ def load_model_variables(path: str) -> Any:
         path = os.path.join(path, MODEL_FILE)
     with open(path, "rb") as fp:
         return serialization.msgpack_restore(fp.read())
+
+
+# ------------------------------------------------------- weights fingerprint
+MODEL_MANIFEST = "model_manifest.json"
+
+
+def _fingerprint_rows(variables: Any):
+    """Sorted (path, shape, dtype, crc32) rows over the variables tree.
+    Goes through ``to_state_dict`` so FrozenDict / plain-dict / msgpack-
+    restored trees of the same weights hash identically."""
+    state = serialization.to_state_dict(variables)
+    for path, leaf in _flatten(state):
+        if leaf is None or (isinstance(leaf, dict) and not leaf):
+            continue
+        arr = np.asarray(jax.device_get(leaf))
+        yield ("/".join(path), str(arr.shape), str(arr.dtype),
+               _crc32(arr.tobytes()))
+
+
+def weights_structure_digest(variables: Any) -> str:
+    """The config-hash half of the fingerprint: sha1 over the sorted
+    (path | shape | dtype) rows — two checkpoints of the same
+    architecture share it even when their values differ."""
+    h = hashlib.sha1()
+    for path, shape, dtype, _ in _fingerprint_rows(variables):
+        h.update(f"{path}|{shape}|{dtype}\n".encode())
+    return f"cfg:{h.hexdigest()[:16]}"
+
+
+def weights_fingerprint(variables: Any) -> str:
+    """Identity of a concrete set of weights: sha1 over the sorted
+    (path | shape | dtype | crc32(leaf bytes)) rows.  Recorded in
+    export manifests and carried by every ``KVSlotExport`` — KV is not
+    portable across weights, so migration refuses adoption when the
+    fingerprints differ (serving/transfer.py ``WeightsMismatch``)."""
+    h = hashlib.sha1()
+    for path, shape, dtype, crc in _fingerprint_rows(variables):
+        h.update(f"{path}|{shape}|{dtype}|{crc:#010x}\n".encode())
+    return f"w:{h.hexdigest()[:16]}"
+
+
+def write_model_manifest(model_dir: str, variables: Any,
+                         data: Optional[bytes] = None) -> dict:
+    """``model_manifest.json`` next to ``model.msgpack``: the weights
+    fingerprint + structure digest (and the serialized blob's CRC32
+    when the caller has the bytes in hand).  Returns the manifest."""
+    os.makedirs(model_dir, exist_ok=True)
+    manifest = {
+        "format": 1,
+        "weights_fingerprint": weights_fingerprint(variables),
+        "structure_digest": weights_structure_digest(variables),
+    }
+    if data is not None:
+        manifest["model_crc32"] = _crc32(data)
+        manifest["model_bytes"] = len(data)
+    _atomic_write(
+        os.path.join(model_dir, MODEL_MANIFEST),
+        json.dumps(manifest, indent=1).encode(),
+    )
+    return manifest
+
+
+def load_model_manifest(path: str) -> Optional[dict]:
+    """The export manifest of a model dir (or of ``model.msgpack``'s
+    parent), or None for pre-manifest exports."""
+    if not os.path.isdir(path):
+        path = os.path.dirname(path) or "."
+    try:
+        with open(os.path.join(path, MODEL_MANIFEST)) as fp:
+            return json.load(fp)
+    except (OSError, ValueError):
+        return None
 
 
 # ----------------------------------------------------------- v2 leaf format
@@ -226,6 +299,13 @@ def _write_checkpoint_dir_inner(
         # Topology of the writing mesh (elastic restore reads it to name
         # source vs target axes in reshard errors; None pre-placement).
         "mesh": mesh,
+        # Identity of the weights inside this checkpoint — what a
+        # serving deploy compares before adopting migrated KV.
+        "weights_fingerprint": (
+            weights_fingerprint({"params": state_dict["params"]})
+            if isinstance(state_dict, dict) and "params" in state_dict
+            else None
+        ),
     }
     with open(os.path.join(tmp_dir, MANIFEST), "w") as fp:
         json.dump(manifest, fp)

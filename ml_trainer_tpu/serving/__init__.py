@@ -62,11 +62,13 @@ from ml_trainer_tpu.serving.loadgen import (
     schedule_from_trace,
     schedule_to_records,
 )
+from ml_trainer_tpu.serving.deploy import DeployConfig, Deployment
 from ml_trainer_tpu.serving.fleet import Fleet, RemoteServer
 from ml_trainer_tpu.serving.router import Router
 from ml_trainer_tpu.serving.transfer import (
     KVSlotExport,
     MigrationCorrupt,
+    WeightsMismatch,
     export_kv_slot,
     import_kv_slot,
 )
@@ -81,6 +83,9 @@ __all__ = [
     "RemoteServer",
     "Autoscaler",
     "AutoscalerConfig",
+    "DeployConfig",
+    "Deployment",
+    "WeightsMismatch",
     "CircuitBreaker",
     "DegradationConfig",
     "DegradationLadder",

@@ -624,6 +624,9 @@ class Server:
             "queue_depth": self.scheduler.queue_depth(),
             "adoptions_pending": len(self._adoptions),
             "degradation_level": self._degradation_level,
+            # Which weights this replica serves (deploy generations key
+            # KV portability and placement on it).
+            "weights_fp": getattr(engine, "weights_fp", None),
             "kv_pages_free": (
                 engine.pool.free_count() if engine.paged else None
             ),
@@ -829,7 +832,10 @@ class Server:
             # visible to the watchdog/error handler (the request is not
             # in engine._active yet) and fails its stream instead of
             # hanging the client.
-            from ml_trainer_tpu.serving.transfer import MigrationCorrupt
+            from ml_trainer_tpu.serving.transfer import (
+                MigrationCorrupt,
+                WeightsMismatch,
+            )
 
             self._admitting_req = req
             try:
@@ -842,15 +848,24 @@ class Server:
                 # never poison the loop.  With a resolver (fleet RPC)
                 # the corrupt verdict is REPORTED instead: the remote
                 # router owns the payload and its fallback candidates.
+                # A WeightsMismatch is the same refusal shape but its
+                # own wire verdict — retrying other candidates of the
+                # same generation cannot help, the router must
+                # re-prefill instead.
                 self._admitting_req = None
                 sched.release(slot)
-                req.mark("adopt_corrupt", error=str(e))
+                verdict = (
+                    "weights_mismatch"
+                    if isinstance(e, WeightsMismatch) else "corrupt"
+                )
+                req.mark(f"adopt_{verdict}", error=str(e))
                 self._log.error(
-                    "serving_adopt_corrupt", request=req.id, error=str(e)
+                    f"serving_adopt_{verdict}", request=req.id,
+                    error=str(e),
                 )
                 if resolver is not None:
                     self.slo.forget(req)
-                    resolver("corrupt", str(e))
+                    resolver(verdict, str(e))
                 else:
                     sched.requeue(req)
                 progressed = True
@@ -1435,6 +1450,7 @@ class Server:
                         "max_queue": server.scheduler.max_queue,
                         "role": server.role,
                         "pid": os.getpid(),
+                        "weights_fp": getattr(eng, "weights_fp", None),
                         "compiles": (
                             compile_watch.compile_count()
                             if compile_watch.installed() else None

@@ -210,17 +210,46 @@ class Autoscaler:
         )
         return now - self._last_action_at >= gap
 
-    def _scale_up(self, role: str, cause: str, now: float) -> bool:
+    def _dead_stderr(self) -> dict:
+        """Bounded log tails of replicas whose worker PROCESS died after
+        the readiness handshake ({name: tail}).  A post-ready crash
+        loses its stderr otherwise — the process is gone, the socket
+        just sever — so the replace-dead flight event carries the
+        post-mortem (RemoteServer.stderr_tail; in-process replicas have
+        no process to lose)."""
+        tails = {}
+        for rep in self.router.replicas.values():
+            if rep.healthy:
+                continue
+            proc = getattr(rep.server, "proc", None)
+            tail_fn = getattr(rep.server, "stderr_tail", None)
+            if proc is None or tail_fn is None:
+                continue
+            if proc.poll() is None:  # still running: unhealthy != dead
+                continue
+            tail = tail_fn()
+            if tail:
+                tails[rep.name] = tail[-2048:]
+        return tails
+
+    def _scale_up(self, role: str, cause: str, now: float,
+                  repair: bool = False) -> bool:
         self._auto_seq += 1
         name = f"auto{self._auto_seq}"
+        extra = {}
+        if repair:
+            dead = self._dead_stderr()
+            if dead:
+                extra["dead_stderr"] = dead
         try:
             server = self.factory(role)
             self.router.add_replica(name, server)
         except Exception as e:  # noqa: BLE001 — a failed add is an event
-            self._record("scale_up_failed", f"{cause}: {e}", role=role)
+            self._record("scale_up_failed", f"{cause}: {e}", role=role,
+                         **extra)
             return False
         self._last_action_at = now
-        self._record("scale_up", cause, role=role, replica=name)
+        self._record("scale_up", cause, role=role, replica=name, **extra)
         return True
 
     def _scale_down(self, fleet: dict, cause: str, now: float) -> bool:
@@ -302,20 +331,20 @@ class Autoscaler:
                     if self._scale_up(
                         "decode", "decode fleet below floor "
                         f"({len(fleet['decode'])} < {cfg.min_decode})",
-                        now,
+                        now, repair=True,
                     ):
                         return "scale_up"
                 if len(fleet["prefill"]) < cfg.min_prefill:
                     if self._scale_up(
                         "prefill", "prefill fleet below floor "
                         f"({len(fleet['prefill'])} < {cfg.min_prefill})",
-                        now,
+                        now, repair=True,
                     ):
                         return "scale_up"
             elif fleet["total"] < cfg.min_replicas:
                 if self._scale_up(
                     "both", f"fleet below floor ({fleet['total']} < "
-                    f"{cfg.min_replicas})", now,
+                    f"{cfg.min_replicas})", now, repair=True,
                 ):
                     return "scale_up"
 

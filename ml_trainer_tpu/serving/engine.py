@@ -332,6 +332,13 @@ class SlotDecodeEngine:
         self.params = (
             variables["params"] if "params" in variables else variables
         )
+        # Identity of the weights this engine serves — KV migrated
+        # between engines is only portable when the fingerprints match
+        # (transfer.import_kv_slot refuses with WeightsMismatch
+        # otherwise); a deploy's generation boundary is keyed on it.
+        from ml_trainer_tpu.checkpoint import weights_fingerprint
+
+        self.weights_fp = weights_fingerprint({"params": self.params})
         # Decode-only int8 clone + the host-built "quant" collection
         # (ops/kernels/quantize_tree): prefill / verify / continuation
         # windows keep running the fp32 ``self.dm`` programs — only the

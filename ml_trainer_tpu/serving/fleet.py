@@ -57,6 +57,7 @@ from ml_trainer_tpu.serving.scheduler import (
 )
 from ml_trainer_tpu.serving.transfer import (
     MigrationCorrupt,
+    WeightsMismatch,
     request_wire_meta,
 )
 
@@ -104,11 +105,13 @@ class RemoteServer:
     in-process kill path."""
 
     def __init__(self, url: str, proc: Optional[subprocess.Popen] = None,
-                 name: str = "", stream_timeout: float = 600.0):
+                 name: str = "", stream_timeout: float = 600.0,
+                 log_path: Optional[str] = None):
         self.url = url.rstrip("/")
         self.proc = proc
         self.name = name or self.url
         self.transport = "http"
+        self.log_path = log_path
         self._stream_timeout = float(stream_timeout)
         self._log = get_logger("ml_trainer_tpu.serving.fleet")
         spec = self._get("/v1/spec", timeout=10.0)
@@ -121,6 +124,7 @@ class RemoteServer:
             paged=bool(spec["paged"]),
             max_batch=int(spec["max_batch"]),
             prefill_chunk=int(spec.get("prefill_chunk", 0)),
+            weights_fp=spec.get("weights_fp"),
         )
         self.scheduler = types.SimpleNamespace(
             max_queue=int(spec["max_queue"])
@@ -185,6 +189,22 @@ class RemoteServer:
         except Exception:
             pass
 
+    def stderr_tail(self, max_bytes: int = 2048) -> Optional[str]:
+        """Bounded tail of the worker's combined stdout+stderr log —
+        the post-mortem a post-ready crash would otherwise lose (the
+        readiness handshake only surfaces PRE-ready exits).  The
+        autoscaler attaches it to the replace-dead flight event."""
+        if not self.log_path:
+            return None
+        try:
+            with open(self.log_path, "rb") as fp:
+                fp.seek(0, os.SEEK_END)
+                size = fp.tell()
+                fp.seek(max(size - int(max_bytes), 0))
+                return fp.read().decode("utf-8", errors="replace")
+        except OSError:
+            return None
+
     def health(self) -> dict:
         try:
             return self._get("/healthz", timeout=2.0)
@@ -219,6 +239,8 @@ class RemoteServer:
             raise RuntimeError(err)
         if status == "corrupt":
             raise MigrationCorrupt(err)
+        if status == "weights_mismatch":
+            raise WeightsMismatch(err)
         if status == "no_memory":
             raise AdmissionError(f"adoption refused (no_memory): {err}")
         raise RuntimeError(f"unexpected fleet reply: {first}")
@@ -481,9 +503,14 @@ class Fleet:
         env["JAX_COMPILATION_CACHE_DIR"] = self.compile_cache_dir
         return env
 
-    def spawn(self, name: str, role: str) -> RemoteServer:
+    def spawn(self, name: str, role: str,
+              ckpt: Optional[str] = None) -> RemoteServer:
         """Spawn one replica process and block until its HTTP front end
-        answers ``/v1/spec`` (the compile-warm readiness gate)."""
+        answers ``/v1/spec`` (the compile-warm readiness gate).  With
+        ``ckpt`` the worker loads its weights from that export
+        (``model.msgpack`` path or dir) instead of the seed init — the
+        deploy path (serving/deploy.py) spawns new-generation replicas
+        this way."""
         port = _free_port()
         url = f"http://{self.host}:{port}"
         cmd = [
@@ -498,6 +525,8 @@ class Fleet:
             "--seed", str(self.seed),
             "--prefill-chunk", str(self.prefill_chunk),
         ]
+        if ckpt:
+            cmd += ["--ckpt", ckpt]
         if not self.prefix_cache:
             cmd.append("--no-prefix-cache")
         log_path = os.path.join(self.log_dir, f"{name}.log")
@@ -519,11 +548,12 @@ class Fleet:
                 remote = RemoteServer(
                     url, proc=proc, name=name,
                     stream_timeout=self.stream_timeout,
+                    log_path=log_path,
                 )
                 self.replicas[name] = remote
                 self._log.info(
                     "fleet_spawn", name=name, role=role, url=url,
-                    pid=remote.pid,
+                    pid=remote.pid, ckpt=ckpt,
                 )
                 return remote
             except Exception as e:
@@ -545,6 +575,16 @@ class Fleet:
         replace-dead repair) spawns a REAL process."""
         return self.spawn(self._next_name(role), role)
 
+    def deploy_factory(self, ckpt: str):
+        """A ``server_factory`` bound to a checkpoint: new-generation
+        replicas for ``Router.deploy`` load their weights from ``ckpt``
+        (and share the fleet's on-disk compile cache, so a deploy is
+        not a recompile storm)."""
+        def spawn(role: str) -> RemoteServer:
+            return self.spawn(self._next_name(role), role, ckpt=ckpt)
+
+        return spawn
+
     def kill(self, name: str) -> None:
         """SIGKILL one replica process directly (chaos harness)."""
         self.replicas[name].kill_process()
@@ -564,11 +604,15 @@ class Fleet:
         from ml_trainer_tpu.serving.router import Router
 
         router_kwargs.setdefault("own_servers", True)
-        return Router(
+        router = Router(
             replicas=dict(self.replicas),
             replica_urls={n: r.url for n, r in self.replicas.items()},
             **router_kwargs,
         )
+        # Router.deploy spawns new-generation workers through this
+        # launcher's checkpoint-loading factory.
+        router.fleet = self
+        return router
 
 
 # -- worker entry ---------------------------------------------------------
@@ -595,6 +639,10 @@ def _worker_main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--prefill-chunk", type=int, default=0)
     parser.add_argument("--no-prefix-cache", action="store_true")
+    parser.add_argument("--ckpt", default=None,
+                        help="load weights from this model export "
+                        "(model.msgpack path or dir) instead of the "
+                        "seed init — the deploy path")
     args = parser.parse_args(argv)
 
     import jax
@@ -616,10 +664,15 @@ def _worker_main(argv: Optional[List[str]] = None) -> int:
 
     compile_watch.install()
     model = get_model(args.model, max_len=args.max_len)
-    variables = model.init(
-        {"params": jax.random.PRNGKey(args.seed)},
-        np.zeros((1, 8), np.int32), train=False,
-    )
+    if args.ckpt:
+        from ml_trainer_tpu.checkpoint import load_model_variables
+
+        variables = load_model_variables(args.ckpt)
+    else:
+        variables = model.init(
+            {"params": jax.random.PRNGKey(args.seed)},
+            np.zeros((1, 8), np.int32), train=False,
+        )
     server = Server(
         model, variables, max_batch=args.max_batch,
         max_queue=args.max_queue, kv_page_size=args.kv_page_size,

@@ -59,6 +59,7 @@ accounting on ``/slo``.
 from __future__ import annotations
 
 import bisect
+import dataclasses
 import hashlib
 import json
 import queue as _queue
@@ -101,11 +102,20 @@ class Replica:
 
     def __init__(self, name: str, server: Server,
                  url: Optional[str] = None,
-                 breaker: Optional[CircuitBreaker] = None):
+                 breaker: Optional[CircuitBreaker] = None,
+                 generation: int = 0):
         self.name = name
         self.server = server
         self.url = url
         self.role = server.role
+        # Deploy generation (serving/deploy.py): which weights wave this
+        # replica belongs to.  Placement never mixes generations within
+        # one stream — KV is not portable across weights — and the
+        # canary traffic split selects the pool by generation.
+        self.generation = int(generation)
+        self.weights_fp = getattr(
+            getattr(server, "engine", None), "weights_fp", None
+        )
         self.healthy = True
         self.last_health: dict = {}
         # Placements since the last health refresh: the health payload
@@ -369,6 +379,17 @@ class Router:
         self._lock = threading.Lock()
         self._sessions: Dict[str, str] = {}
         self._inflight = 0
+        # Deploy state (serving/deploy.py): the generation whose
+        # replicas serve default traffic, the in-flight deployment's
+        # target generation + tenant-hash fraction, an optional
+        # finished-request tap (shadow replay sampling), and the fleet
+        # launcher when one built this router (Router.deploy uses its
+        # checkpoint-loading factory).
+        self._serving_generation = 0
+        self._deploy_generation: Optional[int] = None
+        self._deploy_fraction = 0.0
+        self._request_tap = None
+        self.fleet = None
         self._stopping = False
         self._stop_event = threading.Event()
         self._httpd = None
@@ -576,12 +597,16 @@ class Router:
     # -- fleet management (serving/autoscaler.py) -------------------------
 
     def add_replica(self, name: str, server: Server,
-                    url: Optional[str] = None) -> None:
+                    url: Optional[str] = None,
+                    generation: Optional[int] = None) -> None:
         """Grow the fleet by one replica (thread-safe; the autoscaler's
         scale-up action).  The new replica inherits the fleet's current
         degradation rung, joins the affinity ring/placement pools, and
         shares the process compile cache — adding capacity under load
-        mints no compiles when the geometry matches (enforced)."""
+        mints no compiles when the geometry matches (enforced).
+        ``generation`` defaults to the serving generation, so autoscaler
+        scale-ups/repairs during a deploy grow the STABLE fleet; the
+        deploy machinery passes its target generation explicitly."""
         if name in self._replicas:
             raise ValueError(f"replica '{name}' already exists")
         if server.role not in ("prefill", "decode", "both"):
@@ -596,7 +621,10 @@ class Router:
             # base URL — the autoscaler's factory path adds replicas
             # without threading one through.
             url = getattr(server, "url", None)
-        rep = Replica(name, server, url, breaker=self._new_breaker())
+        if generation is None:
+            generation = self._serving_generation
+        rep = Replica(name, server, url, breaker=self._new_breaker(),
+                      generation=generation)
         server.set_degradation(self.ladder.level, self.ladder.config)
         rep.last_health = rep.fetch_health()
         with self._lock:
@@ -611,6 +639,7 @@ class Router:
         get_recorder().record(
             "fleet_change", action="add_replica", replica=name,
             role=server.role, fleet=len(self._replicas),
+            generation=generation,
         )
         self._log.info(
             "router_replica_added", replica=name, role=server.role
@@ -718,6 +747,48 @@ class Router:
         )
         return True
 
+    def deploy(self, ckpt: str, canary: float = 0.05,
+               shadow: bool = False, *, factory=None, config=None):
+        """Roll the fleet onto new base weights under live traffic
+        (serving/deploy.py, docs/serving.md "Deploys"): spawn
+        new-generation replicas from the ``ckpt`` export (sharing the
+        fleet's on-disk compile cache — no recompile storm), route the
+        deterministic tenant-hash slice ``[0, canary)`` at them, watch
+        the canary slice's SLO burn, and either ramp 5% -> 50% -> 100%
+        and retire the old generation, or auto-roll-back through the
+        drain/evacuate machinery with zero dropped streams.  With
+        ``shadow=True`` a sampled fraction of live requests is replayed
+        against the new replicas OFF the serving path and diffed into
+        ``Deployment.shadow_report()`` before any real traffic moves.
+
+        ``factory`` (role -> server) defaults to the attached fleet's
+        checkpoint-loading factory (``Fleet.make_router`` wires
+        ``router.fleet``); in-process callers pass their own.  Returns
+        the started :class:`~ml_trainer_tpu.serving.deploy.Deployment`
+        — ``wait()`` for the verdict, ``close()`` to stop watching."""
+        from ml_trainer_tpu.serving.deploy import DeployConfig, Deployment
+
+        active = getattr(self, "_deployment", None)
+        if active is not None and not active.finished():
+            raise RuntimeError(
+                f"a deployment is already {active.state}; wait for it "
+                "or close() it before starting another"
+            )
+        if factory is None:
+            if self.fleet is None:
+                raise ValueError(
+                    "Router.deploy needs a server factory: attach a "
+                    "Fleet (Fleet.make_router) or pass factory="
+                )
+            factory = self.fleet.deploy_factory(ckpt)
+        cfg = config if config is not None else DeployConfig()
+        if canary is not None:
+            cfg = dataclasses.replace(cfg, canary=float(canary))
+        if shadow:
+            cfg = dataclasses.replace(cfg, shadow=True)
+        self._deployment = Deployment(self, ckpt, factory, config=cfg)
+        return self._deployment.start()
+
     def _adopt_evacuated(self, req: Request, export, source: Replica
                          ) -> None:
         """Adoption sink for a role-flip evacuation: land the exported
@@ -725,7 +796,7 @@ class Router:
         serialization per candidate).  When nobody can take it, the
         request fails with a retryable ``draining`` error and its pump
         redistributes — byte-identical either way."""
-        for rep in self._decode_candidates():
+        for rep in self._decode_candidates(generation=source.generation):
             if rep is source or not rep.try_place():
                 continue
             payload = transfer.to_bytes(export)
@@ -795,11 +866,17 @@ class Router:
         with self._lock:
             snap["inflight"] = self._inflight
             snap["sessions"] = len(self._sessions)
+            snap["serving_generation"] = self._serving_generation
+            snap["deploy_generation"] = self._deploy_generation
+            snap["deploy_fraction"] = self._deploy_fraction
         return snap
 
     def close(self) -> None:
         self._stopping = True
         self._stop_event.set()
+        deployment = getattr(self, "_deployment", None)
+        if deployment is not None:
+            deployment.close()
         if self._own_servers:
             for rep in self._replicas.values():
                 rep.server.close()
@@ -822,6 +899,55 @@ class Router:
         return {
             n: r for n, r in self._replicas.items() if r.placeable()
         }
+
+    # -- deploy traffic split (serving/deploy.py) --------------------------
+
+    @staticmethod
+    def tenant_slice(tenant: str) -> float:
+        """Deterministic [0, 1) coordinate for a tenant: a deploy at
+        fraction ``f`` routes exactly the tenants with
+        ``tenant_slice(t) < f`` to the new generation — the same
+        tenants on every poll, every process, every ramp stage (the
+        canary slice is a stable cohort, not a coin flip per request)."""
+        h = hashlib.sha1(b"deploy|" + tenant.encode()).hexdigest()[:8]
+        return int(h, 16) / float(1 << 32)
+
+    def set_deploy_split(self, generation: Optional[int],
+                         fraction: float) -> None:
+        """Point the tenant-hash slice ``[0, fraction)`` at
+        ``generation`` (None tears the split down — all traffic back on
+        the serving generation)."""
+        with self._lock:
+            self._deploy_generation = generation
+            self._deploy_fraction = float(fraction)
+
+    def promote_generation(self, generation: int) -> None:
+        """Make ``generation`` the serving generation (deploy ramp
+        completed): default traffic — and autoscaler-grown capacity —
+        now lands there."""
+        with self._lock:
+            self._serving_generation = int(generation)
+            self._deploy_generation = None
+            self._deploy_fraction = 0.0
+
+    def _target_generation(self, tenant: str) -> int:
+        """Which generation serves this tenant right now."""
+        gen, frac = self._deploy_generation, self._deploy_fraction
+        if gen is not None and self.tenant_slice(tenant) < frac:
+            return gen
+        return self._serving_generation
+
+    @staticmethod
+    def _gen_pool(pool: Dict[str, Replica], generation: int
+                  ) -> Dict[str, Replica]:
+        """Restrict a placement pool to one deploy generation.  An
+        empty restriction falls back to the full pool — serving
+        somewhere beats refusing (the deploy monitors burn; it never
+        relies on placement failing closed)."""
+        sub = {
+            n: r for n, r in pool.items() if r.generation == generation
+        }
+        return sub or pool
 
     def _affinity_key(self, tenant: str, prompt: np.ndarray,
                       adapter: Optional[str] = None) -> bytes:
@@ -849,6 +975,10 @@ class Router:
         alive = self._alive()
         if not alive:
             raise EngineUnhealthy("no healthy replica available")
+        # Deploy split first: the whole attempt places within ONE
+        # generation (prefill, decode, hedges, adoption candidates) —
+        # KV never crosses a weights boundary mid-stream.
+        alive = self._gen_pool(alive, self._target_generation(creq.tenant))
         key = self._affinity_key(creq.tenant, creq.prompt, creq.adapter)
         if self.mode == "colocated":
             pool = {
@@ -908,8 +1038,14 @@ class Router:
         decode.pending += 1
         return prefill, decode
 
-    def _decode_candidates(self) -> List[Replica]:
+    def _decode_candidates(self, generation: Optional[int] = None
+                           ) -> List[Replica]:
         alive = self._alive()
+        if generation is not None:
+            alive = {
+                n: r for n, r in alive.items()
+                if r.generation == generation
+            }
         pool = [
             r for r in alive.values() if r.role in ("decode", "both")
         ] or list(alive.values())
@@ -929,6 +1065,13 @@ class Router:
         finally:
             with self._lock:
                 self._inflight -= 1
+            tap = self._request_tap
+            if tap is not None:
+                try:  # shadow-replay sampling (serving/deploy.py) —
+                    # observability must never fail a served stream
+                    tap(creq)
+                except Exception:  # noqa: BLE001
+                    pass
 
     def _remaining_deadline(self, creq: Request) -> Optional[float]:
         if creq.deadline is None:
@@ -1241,6 +1384,7 @@ class Router:
         pool = [
             r for r in alive.values()
             if r.role in ("prefill", "both") and r is not primary_prefill
+            and r.generation == primary_prefill.generation
         ]
         if not pool:
             return None, None
@@ -1288,8 +1432,15 @@ class Router:
         candidate instead of silently adopting garbage."""
         from ml_trainer_tpu.resilience.faults import active_plan
 
+        # Fallback candidates stay within the exporting attempt's
+        # generation: adopting onto other weights would be refused with
+        # weights_mismatch anyway (transfer.import_kv_slot) — don't
+        # burn serialization round-trips finding that out.
         candidates = [decode_rep] + [
-            r for r in self._decode_candidates() if r is not decode_rep
+            r for r in self._decode_candidates(
+                generation=decode_rep.generation
+            )
+            if r is not decode_rep
         ]
         for rep in candidates:
             if not rep.try_place():

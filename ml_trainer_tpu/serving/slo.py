@@ -342,14 +342,22 @@ class SloTracker:
         ).set(float(snap["requests_observed"]))
         return snap
 
-    def timelines(self, since: Optional[float] = None) -> list:
+    def timelines(self, since: Optional[float] = None,
+                  tenants=None, predicate=None) -> list:
         """The retained finished timelines (oldest first), optionally
         only those submitted at/after monotonic stamp ``since`` — how
-        the load harness scopes its aggregation to one timed window."""
+        the load harness scopes its aggregation to one timed window.
+        ``tenants`` (a container of tenant names) and/or ``predicate``
+        (timeline dict -> bool) narrow further — how a deploy scopes
+        burn to its canary traffic slice (serving/deploy.py)."""
         with self._lock:
             tls = list(self._timelines)
         if since is not None:
             tls = [tl for tl in tls if tl["submitted_at"] >= since]
+        if tenants is not None:
+            tls = [tl for tl in tls if tl.get("tenant") in tenants]
+        if predicate is not None:
+            tls = [tl for tl in tls if predicate(tl)]
         return tls
 
     def context_payload(self) -> dict:

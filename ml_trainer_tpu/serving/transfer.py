@@ -67,6 +67,18 @@ class MigrationCorrupt(ValueError):
     CRC-verified, corrupt dirs are quarantined)."""
 
 
+class WeightsMismatch(MigrationCorrupt):
+    """The export was produced by an engine serving DIFFERENT weights
+    than the adopting engine (checkpoint.weights_fingerprint recorded at
+    export vs the target's own).  KV is not portable across weights — a
+    cache built by model A decoded under model B yields plausible
+    garbage, not an error — so adoption refuses structurally and the
+    stream re-prefills on a same-generation replica instead.  Subclasses
+    :class:`MigrationCorrupt` so every existing refuse-and-fall-back
+    path treats it safely; the fleet wire reports it as the distinct
+    ``weights_mismatch`` verdict."""
+
+
 def _leaf_name(path):
     """Last dict key of a tree path (None for non-dict paths)."""
     return getattr(path[-1], "key", None) if path else None
@@ -97,6 +109,11 @@ class KVSlotExport:
     # hand-built exports (unit tests); every real export carries them
     # and import/deserialization verify before any page is scattered.
     crc32s: Optional[List[int]] = None
+    # Fingerprint of the weights the exporting engine serves
+    # (engine.weights_fp).  None on hand-built exports; when both sides
+    # carry one, import refuses a mismatch with WeightsMismatch before
+    # any page allocates.
+    weights_fp: Optional[str] = None
 
     def nbytes(self) -> int:
         """Device-payload bytes this migration moves (the metered
@@ -188,6 +205,7 @@ def export_kv_slot(engine, slot: int) -> KVSlotExport:
         crc32s=[
             zlib.crc32(np.ascontiguousarray(a).tobytes()) for a in layers
         ],
+        weights_fp=getattr(engine, "weights_fp", None),
     )
 
 
@@ -214,9 +232,20 @@ def import_kv_slot(engine, req, slot: int, exp: KVSlotExport) -> str:
             f"{exp.max_len}), target is {pool.page_size} x "
             f"{pool.pages_per_slot} (max_len {engine.max_len})"
         )
-    # CRC gate BEFORE any page allocates or scatters: a corrupt payload
-    # must never become resident K/V (silent garbage would decode into
-    # plausible-looking wrong tokens).
+    # Fingerprint gate BEFORE any page allocates or scatters: KV built
+    # by different weights would decode into plausible-looking wrong
+    # tokens, so a cross-generation adoption refuses structurally
+    # (deploys migrate sessions only at generation boundaries).
+    target_fp = getattr(engine, "weights_fp", None)
+    if (exp.weights_fp is not None and target_fp is not None
+            and exp.weights_fp != target_fp):
+        raise WeightsMismatch(
+            f"weights_mismatch: export from weights {exp.weights_fp}, "
+            f"adopting engine serves {target_fp} — KV is not portable "
+            "across weights; re-prefill on a same-generation replica"
+        )
+    # CRC gate likewise before any allocation: a corrupt payload must
+    # never become resident K/V.
     exp.verify()
     paths = _pool_leaf_paths(engine.cache)
     if len(paths) != len(exp.layers):
@@ -354,6 +383,7 @@ def to_bytes(exp: KVSlotExport) -> bytes:
         "temperature": exp.temperature,
         "step_counter": exp.step_counter,
         "n_layers": len(exp.layers),
+        "weights_fp": exp.weights_fp,
         "crc32s": (
             list(exp.crc32s) if exp.crc32s is not None
             else [
@@ -473,4 +503,5 @@ def _from_bytes_unchecked(payload: bytes) -> KVSlotExport:
                 z[f"layer_{i}"] for i in range(int(meta["n_layers"]))
             ],
             crc32s=[int(c) for c in meta.get("crc32s", [])] or None,
+            weights_fp=meta.get("weights_fp"),
         )

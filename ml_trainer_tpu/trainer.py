@@ -2600,9 +2600,19 @@ class Trainer:
                         goodput.timed("ckpt_stall"):
                     data = serialization.to_bytes(host_vars)
                     ckpt.write_model_bytes(self.model_dir, data)
+                    # The export manifest carries the weights fingerprint
+                    # a serving deploy keys KV portability on
+                    # (docs/serving.md "Deploys").
+                    ckpt.write_model_manifest(
+                        self.model_dir, host_vars, data=data
+                    )
                     if improved and self.save_best:
                         ckpt.write_model_bytes(
                             os.path.join(self.model_dir, "best"), data
+                        )
+                        ckpt.write_model_manifest(
+                            os.path.join(self.model_dir, "best"),
+                            host_vars, data=data,
                         )
             if self._sharded_ckpt:
                 # COLLECTIVE: every process contributes its addressable
@@ -3552,7 +3562,9 @@ class Trainer:
         Unlike the reference, saving does NOT move the live model off the
         accelerator (the ref's ``.cpu()`` side effect is a quirk we fix)."""
         logger.info("Saving the model.")
-        ckpt.save_model_variables(model_dir, self._state_variables())
+        host_vars = ckpt.fetch_to_host(self._state_variables())
+        ckpt.save_model_variables(model_dir, host_vars)
+        ckpt.write_model_manifest(model_dir, host_vars)
 
     def export_lora(self, path: str, name: Optional[str] = None) -> dict:
         """Write the trained adapter as one ``.npz`` artifact — the unit

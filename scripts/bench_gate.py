@@ -113,6 +113,7 @@ BASELINE_FILE = os.path.join(REPO, ".bench_gate_baseline.json")
 ALL_LEGS = frozenset({
     "parity", "serve", "mixed", "pipeline", "slo", "disagg", "lora",
     "overload", "goodput", "elastic", "lint", "fleet", "kernels",
+    "deploy",
 })
 
 # Committed artifacts map to exactly the leg that ratchets against
@@ -126,6 +127,7 @@ _ARTIFACT_LEGS = {
     "serving_lora_cpu.json": "lora",
     "serving_chaos_cpu.json": "overload",
     "serving_fleet_cpu.json": "fleet",
+    "serving_deploy_cpu.json": "deploy",
     "memory_goodput_cpu.json": "goodput",
     "elastic_chaos_cpu.json": "elastic",
     "graft_lint_baseline.json": "lint",
@@ -998,6 +1000,125 @@ def gate_fleet(threshold: float, backend: str, fp: str) -> dict:
     return out
 
 
+def committed_deploy_reference(repo: str = REPO):
+    """Post-rollback fleet tokens/s from the committed live-rollout
+    artifact (docs/serving_deploy_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "serving_deploy_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = (data.get("final") or {}).get("tokens_per_sec")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_deploy(threshold: float, backend: str, fp: str) -> dict:
+    """The live-rollout regression gate: a run of the deploy bench
+    (train -> export -> canary deploy mid-load, then a forced canary
+    regression), gated —
+
+    1. **Invariants** (hard): the healthy deploy reaches ``done`` and
+       the forced regression reaches ``rolled_back`` within one burn
+       window, restoring the pre-deploy replica set; zero client
+       errors in every leg (no dropped streams across spawn, split,
+       ramp, promote, drain and rollback); every output byte-identical
+       to ``generate()`` on the generation that served it; the steady
+       fleet's per-process compile counts unchanged through both
+       deploys and the final pass; the served weights fingerprint
+       equals the export manifest's.
+    2. **Trajectory/local baseline** on the post-rollback fleet's
+       tokens/s (the ``final`` pass), calibrate-then-ratchet as the
+       other gates.
+    """
+    import bench
+
+    result = bench.bench_serve_deploy(n_requests=16)
+    dep = result.get("deploy") or {}
+    rb = result.get("rollback") or {}
+    fin = result.get("final") or {}
+    out = {
+        "deploy_state": dep.get("state"),
+        "deploy_s": dep.get("deploy_s"),
+        "rollback_state": rb.get("state"),
+        "rollback_s": rb.get("rollback_s"),
+        "rollback_cause": rb.get("rollback_cause"),
+        "final_tokens_per_sec": fin.get("tokens_per_sec"),
+        "fingerprint_match": result.get("fingerprint_match"),
+        "threshold": threshold,
+    }
+    if dep.get("state") != "done":
+        out.update(ok=False, decided_by="deploy_verdict",
+                   error=f"healthy deploy ended "
+                   f"'{dep.get('state')}', not done")
+        return out
+    if rb.get("state") != "rolled_back" or rb.get("rollback_s") is None:
+        out.update(ok=False, decided_by="rollback_verdict",
+                   error=f"forced regression ended "
+                   f"'{rb.get('state')}' (rollback_s "
+                   f"{rb.get('rollback_s')}), not a burn-driven "
+                   "rollback")
+        return out
+    if rb["rollback_s"] > result.get("rollback_within_window_s",
+                                     float("inf")):
+        out.update(ok=False, decided_by="rollback_latency",
+                   error=f"rollback took {rb['rollback_s']}s — "
+                   "outside one burn window")
+        return out
+    n_err = (dep.get("n_client_errors", 1) + rb.get("n_client_errors", 1)
+             + fin.get("n_errors", 1))
+    if n_err:
+        out.update(ok=False, decided_by="client_errors",
+                   error=f"{n_err} client error(s) — streams dropped "
+                   "during a rollout")
+        return out
+    if not (dep.get("byte_identical") and rb.get("byte_identical")
+            and fin.get("byte_identical")):
+        out.update(ok=False, decided_by="identity",
+                   error="output diverged from generate() during a "
+                   "rollout")
+        return out
+    if not (dep.get("zero_steady_recompiles")
+            and rb.get("zero_steady_recompiles")
+            and fin.get("zero_recompiles")):
+        out.update(ok=False, decided_by="zero_recompile",
+                   error="steady-fleet compiles observed during a "
+                   "deploy: " + json.dumps({
+                       "deploy": dep.get("steady_fleet_compiles"),
+                       "rollback": rb.get("steady_fleet_compiles"),
+                       "final": fin.get("worker_compiles_timed"),
+                   }))
+        return out
+    if not result.get("fingerprint_match"):
+        out.update(ok=False, decided_by="fingerprint",
+                   error="served weights fingerprint != export "
+                   "manifest")
+        return out
+    committed = committed_deploy_reference()
+    deploy_key = f"{backend}_serve_deploy"
+    baseline = load_baseline(deploy_key, fp)
+    decision = evaluate(
+        float(fin["tokens_per_sec"]),
+        committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            deploy_key, fp,
+            max(float(fin["tokens_per_sec"]), baseline or 0.0),
+        )
+    elif "error" not in out:
+        out["error"] = (
+            f"post-rollback fleet {fin['tokens_per_sec']} tokens/s is "
+            f">{threshold * 100:.0f}% below this machine's baseline "
+            f"{baseline}"
+        )
+    return out
+
+
 def committed_overload_reference(repo: str = REPO):
     """Mitigated TTFT attainment from the committed serving-chaos
     artifact (docs/serving_chaos_cpu.json), or None."""
@@ -1506,6 +1627,9 @@ def main() -> int:
                         help="skip the elastic-training chaos gate")
     parser.add_argument("--skip-fleet", action="store_true",
                         help="skip the multi-process serving-fleet gate")
+    parser.add_argument("--skip-deploy", action="store_true",
+                        help="skip the live-rollout (canary deploy + "
+                        "SLO-burn auto-rollback) gate")
     parser.add_argument("--changed-only", action="store_true",
                         help="map the files changed vs --changed-ref to "
                         "gate legs (legs_for_changes) and run only "
@@ -1655,6 +1779,21 @@ def main() -> int:
             f"{fleet['chunked_ttft_ratio']}, "
             f"{fleet['migrations']} socket migration(s), respawned pid "
             f"{fleet['respawned_pid']}",
+            flush=True,
+        )
+    if not args.skip_deploy and "deploy" in selected:
+        dep = gate_deploy(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_deploy": dep}), flush=True)
+        if not dep["ok"]:
+            print(f"BENCH_GATE DEPLOY FAIL: {dep.get('error')}",
+                  flush=True)
+            return 1
+        print(
+            f"BENCH_GATE DEPLOY OK ({dep['decided_by']}): mid-load "
+            f"deploy {dep['deploy_state']} in {dep['deploy_s']}s, "
+            f"forced regression {dep['rollback_state']} "
+            f"{dep['rollback_s']}s after first high burn, "
+            f"{dep['final_tokens_per_sec']} tokens/s post-rollback",
             flush=True,
         )
     if not args.skip_lora and "lora" in selected:

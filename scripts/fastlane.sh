@@ -85,7 +85,17 @@
 # the int8 argmax-agreement quality gate) backed by the kernels gate
 # (bench_gate.py gate_kernels: parity/identity/zero-recompile
 # invariants hard, kernel-vs-gather ratio floor, decode steps/s
-# ratchet vs docs/kernels_cpu.json; --skip-kernels to skip).
+# ratchet vs docs/kernels_cpu.json; --skip-kernels to skip), and a
+# live-rollout smoke leg (scripts/deploy_smoke.py: Trainer.fit a tiny
+# gpt2, export it manifest + weights fingerprint, and Router.deploy
+# the export onto a live 2-process fleet MID-LOAD — canary -> ramp ->
+# promote with zero dropped streams, zero steady-fleet recompiles and
+# byte-identical outputs, then a wedged-factory canary regression
+# auto-rolled-back within one burn window) backed by the deploy gate
+# (bench_gate.py gate_deploy: deploy/rollback-verdict, rollback-
+# latency, identity, zero-recompile and fingerprint invariants hard,
+# post-rollback tokens/s ratchet vs docs/serving_deploy_cpu.json;
+# --skip-deploy to skip).
 #
 # On a PR branch (HEAD != origin/main with origin/main resolvable) the
 # bench gate runs in --changed-only mode: the diff's files map to gate
@@ -153,6 +163,10 @@ echo "# multi-process serving-fleet smoke leg"
 timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 fleet_rc=$?
 [ $fleet_rc -ne 0 ] && echo "# fleet smoke FAILED (rc=$fleet_rc)"
+echo "# live-rollout (canary deploy + auto-rollback) smoke leg"
+timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/deploy_smoke.py
+deploy_rc=$?
+[ $deploy_rc -ne 0 ] && echo "# deploy smoke FAILED (rc=$deploy_rc)"
 echo "# Pallas kernel-layer smoke leg"
 timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/kernels_smoke.py
 kernels_rc=$?
@@ -182,7 +196,7 @@ if [ -z "$FULL_GATE" ] \
   gate_args="--changed-only"
   echo "# (PR branch: bench gate in --changed-only mode; FULL_GATE=1 overrides)"
 fi
-timeout -k 10 2700 env JAX_PLATFORMS=cpu python scripts/bench_gate.py $gate_args
+timeout -k 10 3000 env JAX_PLATFORMS=cpu python scripts/bench_gate.py $gate_args
 gate_rc=$?
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
@@ -198,6 +212,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$overload_rc
 [ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$fleet_rc
+[ $rc -eq 0 ] && rc=$deploy_rc
 [ $rc -eq 0 ] && rc=$kernels_rc
 [ $rc -eq 0 ] && rc=$lint_rc
 [ $rc -eq 0 ] && rc=$ruff_rc

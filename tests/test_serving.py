@@ -243,9 +243,12 @@ def test_health_payload_golden_shape(model_and_vars):
         "adoptions_pending", "closed", "degradation_level", "draining",
         "healthy", "kv_pages_free", "kv_pages_total", "max_slots", "ok",
         "pid", "queue_depth", "queued_requests", "reason", "role",
-        "transport", "uptime_s",
+        "transport", "uptime_s", "weights_fp",
     ]
     assert payload["ok"] is True and payload["role"] == "decode"
+    # Deploys key KV portability on this: same-process servers sharing
+    # variables must report the same fingerprint.
+    assert payload["weights_fp"].startswith("w:")
     # Process-identity fields (serving/fleet.py routes on these to tell
     # a worker process from an in-process replica).
     assert payload["pid"] == os.getpid()
