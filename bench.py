@@ -1563,6 +1563,301 @@ def bench_serve_fleet(n_requests=32, n_tenants=2, long_frac=0.4,
     return result
 
 
+def bench_fleet_obs(n_requests=12, n_tenants=2, mean_interarrival=0.02,
+                    page_size=16, max_batch=2, pool_factor=3, seed=0,
+                    scrape_iters=20, out_path=None):
+    """Fleet observability plane (serving/router.py "fleet plane",
+    docs/observability.md "Fleet plane") measured on a REAL 3-process
+    fleet: the cost of watching the fleet, plus the invariants that
+    make the watching trustworthy.  One committed artifact
+    (docs/fleet_obs_cpu.json):
+
+    * **overhead** — wall-clock for one federated ``/metrics`` scrape
+      sweep (router pulls every worker's exposition over HTTP), one
+      federated render (relabel + merge into the router's own
+      exposition), one fleet trace merge (``GET /trace`` from every
+      worker, clock-align, merge into a single Perfetto timeline), and
+      one full incident-bundle assembly.  All host-side, all off the
+      request path — the numbers bound what the plane costs the router
+      thread, not the workers.
+    * **federation invariants** — every worker series appears in the
+      federated exposition carrying ``replica=``/``role=``/
+      ``generation=`` labels, including each worker's
+      ``compile_events_post_warmup_total`` (rendered at 0, so absence
+      means "watch missing", never "no recompile yet"); a re-scrape +
+      re-render is byte-identical on the worker sections (snapshots
+      replace — histograms cannot double-count).
+    * **trace invariants** — the merged timeline holds >= 2 process
+      lanes and a migrated request whose prefill-side fragment (on the
+      prefill worker's lane) ends before its decode-side span (on a
+      DIFFERENT pid's lane) begins, after clock alignment.
+    * **plane-is-free invariants** — with the plane fully enabled
+      (scraping, tracing, bundling), the replayed trace stays
+      byte-identical to in-driver ``generate()`` and every worker
+      reports zero post-warmup compiles; loadgen rows carry the
+      serving replica id.
+    """
+    import os
+    import tempfile
+
+    from ml_trainer_tpu.models import get_model
+    from ml_trainer_tpu.serving.fleet import Fleet
+    from ml_trainer_tpu.serving.loadgen import (
+        ScheduledRequest, run_open_loop, schedule_from_trace,
+        schedule_to_records,
+    )
+    from ml_trainer_tpu.generate import generate
+
+    max_len = 128
+    model = get_model("gpt2_tiny", max_len=max_len)
+    variables = jax.jit(model.init, static_argnames="train")(
+        {"params": jax.random.PRNGKey(0)}, jnp.zeros((1, 8), jnp.int32),
+        train=False,
+    )
+    rng = np.random.default_rng(seed)
+    rows = [
+        ScheduledRequest(
+            arrival_s=i * mean_interarrival,
+            tenant=f"tenant{i % n_tenants}",
+            prompt=rng.integers(
+                0, model.vocab_size, int(rng.integers(8, 25))
+            ).astype(np.int32),
+            max_new_tokens=int(rng.choice([6, 10])),
+        )
+        for i in range(n_requests)
+    ]
+    trace = schedule_from_trace(schedule_to_records(rows))
+    refs = [
+        [int(t) for t in np.asarray(
+            generate(model, variables, s.prompt[None], s.max_new_tokens)
+        )[0]]
+        for s in trace
+    ]
+    kv_pages = pool_factor * max_batch * (max_len // page_size) + 1
+
+    def worker_compiles(fleet):
+        out = {}
+        for name, rep in fleet.replicas.items():
+            try:
+                out[name] = int(rep._get("/v1/spec")["compiles"] or 0)
+            except Exception:
+                out[name] = None
+        return out
+
+    def _ms(samples):
+        if not samples:
+            return None
+        s = sorted(samples)
+        return {
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3),
+            "p50_ms": round(s[len(s) // 2] * 1e3, 3),
+            "max_ms": round(s[-1] * 1e3, 3),
+            "n": len(s),
+        }
+
+    def worker_lines(text):
+        # The federated exposition's worker sections: every sample line
+        # that carries a replica= label (router-own series do not).
+        return [
+            ln for ln in text.splitlines()
+            if ln and not ln.startswith("#") and 'replica="' in ln
+        ]
+
+    fleet = Fleet(
+        roles=["prefill", "decode", "decode"], model_name="gpt2_tiny",
+        max_len=max_len, max_batch=max_batch, max_queue=4 * n_requests,
+        kv_page_size=page_size, kv_pages=kv_pages, seed=0,
+        prefix_cache=False,
+    )
+    fleet.start()
+    incident_root = tempfile.mkdtemp(prefix="fleet-obs-incident-")
+    router = fleet.make_router(
+        hedging=False, metrics_scrape_interval=0.1,
+        incident_dir=incident_root, incident_min_interval_s=0.0,
+    )
+    result = {
+        "n_requests": n_requests,
+        "page_size": page_size,
+        "max_batch": max_batch,
+        "seed": seed,
+        "backend": jax.default_backend(),
+    }
+    try:
+        host, port = router.serve_http(port=0)
+        url = f"http://{host}:{port}"
+        for _ in range(2):  # untimed: workers compile to steady state
+            run_open_loop(trace, url=url, time_scale=0.0)
+        before = worker_compiles(fleet)
+        client = run_open_loop(trace, url=url, collect_tokens=True)
+        after = worker_compiles(fleet)
+        fresh = {
+            n: (after[n] - before[n])
+            if before.get(n) is not None and after.get(n) is not None
+            else None
+            for n in after
+        }
+        identical = all(
+            r.get("output") == ref
+            for r, ref in zip(client["per_request"], refs)
+        )
+        rows_with_replica = sum(
+            1 for r in client["per_request"] if r.get("replica")
+        )
+
+        # Overhead: scrape sweep / federated render / trace merge.
+        scrape_s, render_s = [], []
+        for _ in range(scrape_iters):
+            t0 = time.perf_counter()
+            router.scrape_metrics(force=True)
+            scrape_s.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            text = router.federated_metrics_text()
+            render_s.append(time.perf_counter() - t0)
+        lines_a = worker_lines(text)
+        router.scrape_metrics(force=True)
+        lines_b = worker_lines(router.federated_metrics_text())
+        idempotent = lines_a == lines_b
+        workers = sorted(fleet.replicas)
+        fed_ok = all(
+            any(
+                ln.startswith("compile_events_post_warmup_total{")
+                and f'replica="{name}"' in ln and 'role="' in ln
+                and 'generation="' in ln
+                for ln in lines_a
+            )
+            for name in workers
+        )
+
+        t0 = time.perf_counter()
+        merged = router.fleet_trace()
+        merge_s = time.perf_counter() - t0
+        events = merged.get("traceEvents", [])
+        lanes = {
+            e.get("pid") for e in events if e.get("ph") != "M"
+        }
+        # A migrated request: its kv_wire span names the trace id; the
+        # prefill fragment and decode span must sit on different lanes
+        # in causal order after clock alignment.
+        causal = None
+        router_pid = os.getpid()  # the router's lane: its own request
+        for ev in events:         # spans start at submit, pre-prefill
+            name = ev.get("name", "")
+            if not name.startswith("kv_wire "):
+                continue
+            tid = name.split(" ", 1)[1]
+            pre = next(
+                (e for e in events
+                 if e.get("name") == f"request {tid} (prefill)"), None,
+            )
+            dec = next(
+                (e for e in events
+                 if e.get("name") == f"request {tid}"
+                 and e.get("pid") not in (
+                     (pre or {}).get("pid"), router_pid,
+                 )), None,
+            )
+            if pre is None or dec is None:
+                continue
+            pre_end = pre["ts"] + pre.get("dur", 0.0)
+            causal = {
+                "trace_id": tid,
+                "prefill_pid": pre["pid"],
+                "decode_pid": dec["pid"],
+                "gap_us": round(dec["ts"] - pre_end, 1),
+                # Epoch alignment is exact on one host; allow the NTP
+                # fallback's rtt/2 error bound.
+                "ordered": bool(dec["ts"] >= pre_end - 5_000.0),
+            }
+            if causal["ordered"]:
+                break
+
+        t0 = time.perf_counter()
+        bundle = router.save_incident_bundle(
+            "bench_fleet_obs", force=True,
+        )
+        bundle_s = time.perf_counter() - t0
+        bundle_files = sorted(os.listdir(bundle)) if bundle else []
+        want = {"flight_router.json", "metrics.prom", "manifest.json",
+                "slo_timelines.json", "router.json"}
+        want |= {f"flight_{n}.json" for n in workers}
+        bundle_ok = bundle is not None and want <= set(bundle_files)
+
+        result.update({
+            "scrape": _ms(scrape_s),
+            "federated_render": _ms(render_s),
+            "trace_merge_ms": round(merge_s * 1e3, 3),
+            "bundle_assembly_ms": round(bundle_s * 1e3, 3),
+            "federated_lines": len(lines_a),
+            "federated_labels_ok": bool(fed_ok),
+            "idempotent_rescrape": bool(idempotent),
+            "trace_lanes": len(lanes),
+            "trace_events": len(events),
+            "migrated_request": causal,
+            "fleet_clock": {
+                n: {"method": c.get("method"),
+                    "rtt_us": c.get("rtt_us")}
+                for n, c in merged.get("fleetClock", {}).items()
+            },
+            "bundle_files": bundle_files,
+            "bundle_ok": bool(bundle_ok),
+            "rows_with_replica": rows_with_replica,
+            "n_errors": client["n_errors"],
+            "byte_identical": bool(identical),
+            "worker_compiles_timed": fresh,
+            "zero_recompiles": all(v == 0 for v in fresh.values()),
+        })
+    finally:
+        router.close()
+        fleet.stop()
+    if not result.get("byte_identical"):
+        result["error"] = (
+            "fleet output diverged from generate() with the plane on"
+        )
+    elif not result.get("zero_recompiles"):
+        result["error"] = "worker compiles observed during a timed pass"
+    elif result.get("n_errors"):
+        result["error"] = f"client errors: {result['n_errors']}"
+    elif not result.get("federated_labels_ok"):
+        result["error"] = (
+            "federated exposition missing worker series/labels"
+        )
+    elif not result.get("idempotent_rescrape"):
+        result["error"] = "re-scrape changed the federated worker lines"
+    elif result.get("trace_lanes", 0) < 2:
+        result["error"] = (
+            f"merged trace holds {result.get('trace_lanes')} lane(s)"
+        )
+    elif not (result.get("migrated_request") or {}).get("ordered"):
+        result["error"] = (
+            "no migrated request in causal order across two lanes"
+        )
+    elif not result.get("bundle_ok"):
+        result["error"] = (
+            f"incident bundle incomplete: {result.get('bundle_files')}"
+        )
+    elif result.get("rows_with_replica", 0) < n_requests:
+        result["error"] = (
+            f"only {result.get('rows_with_replica')}/{n_requests} "
+            "loadgen rows carried a serving replica id"
+        )
+    print(
+        "# fleet obs: scrape "
+        f"{(result.get('scrape') or {}).get('mean_ms')} ms, render "
+        f"{(result.get('federated_render') or {}).get('mean_ms')} ms, "
+        f"merge {result.get('trace_merge_ms')} ms "
+        f"({result.get('trace_lanes')} lanes), bundle "
+        f"{result.get('bundle_assembly_ms')} ms"
+        + ("" if not result.get("error") else
+           f"  [FAILED: {result['error']}]"),
+        flush=True,
+    )
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# fleet obs artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_serve_deploy(n_requests=24, n_tenants=8, mean_interarrival=0.12,
                        page_size=8, max_batch=4, seed=0,
                        ttft_ms=2000.0, tpot_ms=2000.0, wedge_s=3.0,
@@ -3801,6 +4096,17 @@ def main():
                         "zero per-process recompiles pinned; writes "
                         "docs/serving_fleet_cpu.json "
                         "(gpt2_tiny; CPU-safe)")
+    parser.add_argument("--fleet-obs", action="store_true",
+                        help="run only the fleet-observability-plane "
+                        "bench: a 3-process fleet under the router's "
+                        "metrics federation + cross-process tracing + "
+                        "incident bundling, measuring scrape/render/"
+                        "trace-merge/bundle latency and pinning the "
+                        "plane's invariants (labelled worker series, "
+                        "idempotent re-scrape, >= 2 causal trace "
+                        "lanes, complete bundle, byte identity, zero "
+                        "recompiles); writes docs/fleet_obs_cpu.json "
+                        "(gpt2_tiny; CPU-safe)")
     parser.add_argument("--serve-deploy", action="store_true",
                         help="run only the live-rollout bench: train a "
                         "tiny gpt2 in-bench, export it, and deploy the "
@@ -4016,6 +4322,22 @@ def main():
         )
         result = bench_serve_fleet(out_path=out)
         print(json.dumps({"serve_fleet": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.fleet_obs:
+        # Fleet observability plane: federation + tracing + bundles on
+        # a real 3-process fleet; the artifact is the acceptance
+        # evidence for the plane's overhead and feeds bench_gate.py
+        # gate_fleet.
+        import os as _os
+
+        out = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "fleet_obs_cpu.json",
+        )
+        result = bench_fleet_obs(out_path=out)
+        print(json.dumps({"fleet_obs": result}))
         if result.get("error"):
             sys.exit(1)
         return

@@ -68,6 +68,8 @@ from ml_trainer_tpu.serving.scheduler import (
     _DONE,
 )
 from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
+from ml_trainer_tpu.telemetry import compile_watch, spans
+from ml_trainer_tpu.telemetry.flight import get_recorder
 from ml_trainer_tpu.utils.logging import get_logger
 
 # Stream sentinel kind a migration sink pushes between tokens — the
@@ -75,6 +77,26 @@ from ml_trainer_tpu.utils.logging import get_logger
 # import router; the string is the wire contract).  The fleet stream
 # endpoint turns it into an ``{"m": <payload>}`` NDJSON line.
 _KV_MIGRATE = "__kv_migrate__"
+
+# Cross-process trace context rides the fleet RPCs as this header (a
+# JSON object: trace_id / parent / origin_pid).  The wire meta carries
+# the same dict inline for /v1/stream and /v1/adopt; the header is the
+# fallback for clients that speak plain /v1/generate.
+TRACE_HEADER = "X-Trace-Context"
+
+
+def _trace_ctx_header(headers) -> Optional[dict]:
+    """Parse ``X-Trace-Context`` into a trace-ctx dict (None when
+    absent or malformed — a bad trace header must never fail a
+    request)."""
+    raw = headers.get(TRACE_HEADER, "")
+    if not raw:
+        return None
+    try:
+        ctx = json.loads(raw)
+    except (TypeError, ValueError):
+        return None
+    return ctx if isinstance(ctx, dict) and ctx else None
 
 
 class TokenStream:
@@ -307,6 +329,10 @@ class Server:
         # fleet worker flips it to "http".
         self._started_at = time.monotonic()
         self.transport = "inproc"
+        # Fleet-assigned replica name ("p0", "d1", ...): stamped by the
+        # fleet worker main so trace lanes, stream-accept lines and
+        # incident bundle entries attribute to the replica, not a pid.
+        self.name = ""
         # Wire-id -> Request registry for the fleet stream endpoints
         # (/v1/stream, /v1/adopt): lets /v1/cancel reach a stream by the
         # ROUTER's id, which is stable across processes.
@@ -338,7 +364,8 @@ class Server:
                eos_token_id: Optional[int] = None,
                deadline: Optional[float] = None,
                tenant: str = "default", priority: int = 0,
-               adapter: Optional[str] = None) -> TokenStream:
+               adapter: Optional[str] = None,
+               trace: Optional[dict] = None) -> TokenStream:
         """Enqueue one request (thread-safe).  Raises ``AdmissionError``
         when the queue (global or the tenant's) is at its watermark (or
         the server is draining), ``EngineUnhealthy`` when the engine is
@@ -394,6 +421,8 @@ class Server:
             eos_token_id=eos_token_id, deadline=deadline,
             tenant=tenant, priority=int(priority), adapter=adapter,
         )
+        if trace:
+            req.trace_ctx = dict(trace)
         self.submit_request(req)
         return TokenStream(req, prompt)
 
@@ -617,6 +646,16 @@ class Server:
             "pid": os.getpid(),
             "uptime_s": round(time.monotonic() - self._started_at, 3),
             "transport": self.transport,
+            # Fleet observability plane (serving/router.py): per-replica
+            # recompile budget surfaced through the router's aggregated
+            # /healthz, and the clock handshake the router uses to align
+            # this process's trace lane (trace_now_us sampled while the
+            # router brackets the poll with its own clock).
+            "compile_events_post_warmup_total": (
+                compile_watch.post_warmup_count()
+                if compile_watch.installed() else None
+            ),
+            **spans.clock_payload(),
             "active_requests": engine.active_count() + engine.chunking_count(),
             "active_slots": engine.active_count() + engine.chunking_count(),
             "max_slots": engine.max_batch,
@@ -907,7 +946,11 @@ class Server:
         engine._active.pop(slot, None)
         engine._release_slot_pages(slot, req, donate=True)
         sched.release(slot)
-        # The decode replica's tracker takes over at adopt().
+        # The decode replica's tracker takes over at adopt(); before the
+        # tracker forgets the request, emit this replica's fragment of
+        # the cross-process trace (queue_wait + prefill on THIS lane) so
+        # the merged fleet timeline shows where the prefill ran.
+        self.slo.observe_export(req)
         self.slo.forget(req)
         req.mark(
             "kv_exported", pages=export.n_pages, kv_bytes=export.nbytes(),
@@ -1245,6 +1288,8 @@ class Server:
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if req.trace_ctx is None:
+                    req.trace_ctx = _trace_ctx_header(self.headers)
                 if body.get("migrate"):
                     # Prefill-and-export: the sink pushes the export
                     # into THIS stream, which ships it as an "m" line —
@@ -1272,7 +1317,8 @@ class Server:
                     return
                 server._register_wire(wire_id, req)
                 try:
-                    self._ndjson({"status": "accepted"})
+                    self._ndjson({"status": "accepted",
+                                  "replica": server.name or None})
                     self._stream_tokens(req)
                 finally:
                     server._forget_wire(wire_id)
@@ -1298,6 +1344,8 @@ class Server:
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": f"{type(e).__name__}: {e}"})
                     return
+                if req.trace_ctx is None:
+                    req.trace_ctx = _trace_ctx_header(self.headers)
                 self._ndjson_start()
                 try:
                     export = transfer.from_bytes(payload, verify=True)
@@ -1372,7 +1420,12 @@ class Server:
                         server.role = role
                         self._send(200, {"ok": True, "role": role})
                     elif path == "/admin/replica_index":
-                        server.replica_index = int(body["index"])
+                        # Accept both key spellings: fleet.py's remote
+                        # proxy historically posted "replica_index".
+                        server.replica_index = int(
+                            body["index"] if "index" in body
+                            else body["replica_index"]
+                        )
                         self._send(200, {"ok": True})
                     elif path == "/admin/degradation":
                         cfg = body.get("config")
@@ -1473,6 +1526,20 @@ class Server:
                     )
                 elif self.path == "/metrics.json":
                     self._send(200, server.metrics.snapshot())
+                elif self.path == "/trace":
+                    # Fleet observability plane: this process's span
+                    # buffer plus its clock identity — the router's
+                    # save_fleet_trace() merges these into ONE
+                    # clock-aligned Perfetto timeline with one lane per
+                    # process.
+                    self._send(200, spans.trace_payload(server.name))
+                elif self.path == "/flight":
+                    # The flight-recorder payload WITHOUT a local write:
+                    # incident bundles pull a live worker's forensics
+                    # over the wire.
+                    self._send(
+                        200, get_recorder().payload("fleet_fetch")
+                    )
                 elif self.path == "/slo":
                     # Structured SLO attainment (policy, per-tenant
                     # attainment + burn rate) — the JSON twin of the
@@ -1529,6 +1596,7 @@ class Server:
                         tenant=str(body.get("tenant", "default")),
                         priority=int(body.get("priority", 0)),
                         adapter=body.get("adapter"),
+                        trace=_trace_ctx_header(self.headers),
                         # The HTTP wait is capped by the client's own
                         # deadline (plus engine slack): a deadline'd
                         # request gets its 504 near the deadline even
@@ -1538,7 +1606,10 @@ class Server:
                             if deadline is not None else None
                         ),
                     )
-                    self._send(200, {"tokens": [int(t) for t in out]})
+                    self._send(200, {
+                        "tokens": [int(t) for t in out],
+                        "replica": server.name or None,
+                    })
                 except OverloadShed as e:
                     payload = {"error": str(e)}
                     if e.retry_after is not None:

@@ -241,6 +241,17 @@ class Autoscaler:
             dead = self._dead_stderr()
             if dead:
                 extra["dead_stderr"] = dead
+            # Replace-dead is an incident: bundle the fleet's state
+            # (throttled router-side) before the repair muddies it.
+            trigger = getattr(self.router, "trigger_incident", None)
+            if trigger is not None:
+                try:
+                    trigger(
+                        f"autoscaler_replace_dead: {cause}",
+                        dead=tuple(dead) if dead else (),
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
         try:
             server = self.factory(role)
             self.router.add_replica(name, server)

@@ -610,6 +610,15 @@ class Deployment:
         self.rollback_cause = cause
         self._uninstall_tap()
         self._transition("rolling_back", cause=cause)
+        # Incident bundle BEFORE teardown: the canary replicas' flight
+        # payloads and SLO timelines are the rollback's evidence, and
+        # they vanish with the generation.
+        trigger = getattr(self.router, "trigger_incident", None)
+        if trigger is not None:
+            try:
+                trigger(f"deploy_rollback: {cause}")
+            except Exception:  # noqa: BLE001 — forensics never block it
+                pass
         # Split down FIRST: new canary arrivals land on stable before a
         # single replica starts draining.
         self.router.set_deploy_split(None, 0.0)

@@ -141,6 +141,17 @@ class RemoteServer:
         ) as resp:
             return json.loads(resp.read())
 
+    def _get_text(self, path: str, timeout: float = 5.0) -> str:
+        with urllib.request.urlopen(
+            f"{self.url}{path}", timeout=timeout
+        ) as resp:
+            return resp.read().decode("utf-8", errors="replace")
+
+    def metrics_text(self, timeout: float = 5.0) -> str:
+        """Raw Prometheus text from the worker's ``/metrics`` — what
+        the router's federation scrape re-exports with replica labels."""
+        return self._get_text("/metrics", timeout=timeout)
+
     def _post(self, path: str, body: dict, timeout: float = 10.0) -> dict:
         data = json.dumps(body).encode()
         req = urllib.request.Request(
@@ -309,10 +320,16 @@ class RemoteServer:
         the shadow from a daemon thread."""
         body = request_wire_meta(shadow)
         body["migrate"] = shadow.migration_sink is not None
+        headers = {"Content-Type": "application/json"}
+        if getattr(shadow, "trace_ctx", None):
+            # The trace context also rides the wire meta; the header is
+            # the RPC-level contract (api.py TRACE_HEADER) so even a
+            # meta-stripping proxy keeps the request traceable.
+            headers["X-Trace-Context"] = json.dumps(shadow.trace_ctx)
         try:
             resp = self._open_stream(
                 "/v1/stream", json.dumps(body).encode(),
-                {"Content-Type": "application/json"},
+                headers,
                 self._stream_timeout,
             )
             first = self._read_line(resp)
@@ -335,11 +352,14 @@ class RemoteServer:
         unchanged.  On ``adopted`` the same connection becomes the
         continuation token stream."""
         meta = json.dumps(request_wire_meta(shadow))
+        headers = {"Content-Type": "application/octet-stream",
+                   "X-Request-Meta": meta}
+        if getattr(shadow, "trace_ctx", None):
+            headers["X-Trace-Context"] = json.dumps(shadow.trace_ctx)
         try:
             resp = self._open_stream(
                 "/v1/adopt", payload,
-                {"Content-Type": "application/octet-stream",
-                 "X-Request-Meta": meta},
+                headers,
                 self._stream_timeout,
             )
             first = self._read_line(resp)
@@ -531,8 +551,14 @@ class Fleet:
             cmd.append("--no-prefix-cache")
         log_path = os.path.join(self.log_dir, f"{name}.log")
         log_file = open(log_path, "w")
+        env = self._worker_env()
+        # Per-worker JSONL sink isolation (telemetry/export.py): a
+        # shared ML_TRAINER_TPU_METRICS_JSONL path gains a `.{name}`
+        # suffix in each worker, so N processes never interleave lines
+        # into one file.
+        env["ML_TRAINER_TPU_METRICS_WORKER"] = name
         proc = subprocess.Popen(
-            cmd, env=self._worker_env(),
+            cmd, env=env,
             stdout=log_file, stderr=subprocess.STDOUT,
         )
         log_file.close()  # the child holds its own descriptor
@@ -681,6 +707,7 @@ def _worker_main(argv: Optional[List[str]] = None) -> int:
         prefix_cache=not args.no_prefix_cache,
     )
     server.transport = "http"  # /admin/shutdown may os._exit this process
+    server.name = args.name    # trace lanes / accept lines carry this
     host, port = server.serve_http(args.host, args.port)
     print(
         "FLEET_WORKER_READY "

@@ -245,6 +245,10 @@ def run_open_loop(schedule: Sequence[ScheduledRequest],
                 with urllib.request.urlopen(req, timeout=timeout) as resp:
                     out = json.loads(resp.read())
                 row["tokens"] = len(out["tokens"]) - s.prompt.size
+                # Which replica served the decode (router replies name
+                # it; a single-replica server replies its own name or
+                # None) — per-row attribution for fleet debugging.
+                row["replica"] = out.get("replica")
                 if collect_tokens:
                     row["output"] = [int(t) for t in out["tokens"]]
             else:

@@ -62,7 +62,9 @@ import bisect
 import dataclasses
 import hashlib
 import json
+import os
 import queue as _queue
+import tempfile
 import threading
 import time
 import urllib.error
@@ -72,7 +74,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ml_trainer_tpu.serving import transfer
-from ml_trainer_tpu.serving.api import Server, TokenStream
+from ml_trainer_tpu.serving.api import (
+    Server,
+    TokenStream,
+    _trace_ctx_header,
+)
 from ml_trainer_tpu.serving.overload import (
     CircuitBreaker,
     DegradationConfig,
@@ -88,12 +94,20 @@ from ml_trainer_tpu.serving.scheduler import (
 )
 from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
 from ml_trainer_tpu.serving.transfer import MigrationCorrupt
+from ml_trainer_tpu.telemetry import federation, spans
+from ml_trainer_tpu.telemetry.flight import get_recorder
 from ml_trainer_tpu.utils.logging import get_logger
 
 # Stream sentinel kind the migration sink pushes between tokens: the
 # request's pump adopts the export into the decode replica when it
 # drains this item (tokens are plain ints, _DONE is ("done", None)).
 _MIGRATE = "__kv_migrate__"
+
+# Incident bundles (save_incident_bundle) land under this directory
+# when no explicit ``incident_dir`` was configured; the flight-dump
+# env var is a separate knob on purpose — a bundle COLLECTS flight
+# dumps, it is not one.
+INCIDENT_DIR_ENV = "ML_TRAINER_TPU_INCIDENT_DIR"
 
 
 class Replica:
@@ -130,20 +144,60 @@ class Replica:
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self.fail_polls = 0
         self.removing = False
+        # Fleet observability plane: the replica's latest raw /metrics
+        # exposition (the federation re-exports it with replica labels),
+        # when it was scraped, and the per-process clock estimates the
+        # trace merge aligns lanes with (telemetry/federation.py):
+        # the exact monotonic-epoch shift and the NTP-style handshake
+        # estimate (min-rtt filtered across health polls).
+        self.metrics_text: Optional[str] = None
+        self.metrics_scraped_at = 0.0
+        self.epoch_shift_us: Optional[float] = None
+        self.ntp_shift_us: Optional[float] = None
+        self.ntp_rtt_us: Optional[float] = None
+
+    def _note_clock(self, payload: dict, t0_us: float,
+                    t1_us: float) -> None:
+        """Clock handshake piggybacked on a health fetch: ``payload``
+        carries the worker's trace-clock "now" and monotonic epoch
+        (api.py health() via spans.clock_payload()); ``t0/t1`` bracket
+        the HTTP round-trip on the ROUTER's trace clock."""
+        worker_now = payload.get("trace_now_us")
+        if worker_now is None:
+            return
+        rtt = t1_us - t0_us
+        # NTP-style: the worker's reading maps to the bracket midpoint,
+        # error <= rtt/2.  Keep the tightest bracket seen (min-rtt
+        # filter) — a scheduling hiccup must not loosen the estimate.
+        if self.ntp_rtt_us is None or rtt <= self.ntp_rtt_us:
+            self.ntp_shift_us = (t0_us + t1_us) / 2.0 - float(worker_now)
+            self.ntp_rtt_us = rtt
+        mono_epoch = payload.get("mono_epoch")
+        if mono_epoch is not None:
+            # Exact when time.monotonic() is system-wide (CLOCK_MONOTONIC
+            # on Linux): worker ts + this = ts on the router's clock.
+            self.epoch_shift_us = (
+                float(mono_epoch) - spans._MONO_EPOCH
+            ) * 1e6
 
     def fetch_health(self, timeout: float = 2.0) -> dict:
         """The replica's ``/healthz`` payload — over HTTP when the
         replica exposes a front end (a 503 still carries the payload),
         else the in-process snapshot."""
         if self.url:
+            t0 = spans._now_us()
             try:
                 with urllib.request.urlopen(
                     f"{self.url}/healthz", timeout=timeout
                 ) as resp:
-                    return json.loads(resp.read())
+                    payload = json.loads(resp.read())
+                self._note_clock(payload, t0, spans._now_us())
+                return payload
             except urllib.error.HTTPError as e:
                 try:
-                    return json.loads(e.read())
+                    payload = json.loads(e.read())
+                    self._note_clock(payload, t0, spans._now_us())
+                    return payload
                 except Exception:
                     return {"ok": False, "healthy": False,
                             "reason": f"healthz HTTP {e.code}"}
@@ -151,6 +205,40 @@ class Replica:
                 return {"ok": False, "healthy": False,
                         "reason": f"healthz unreachable: {e}"}
         return self.server.health()
+
+    def fetch_metrics_text(self, timeout: float = 2.0) -> Optional[str]:
+        """Raw ``/metrics`` exposition over HTTP; None for in-process
+        replicas (they share the router's registry already — federating
+        them would double every series).  Raises on an unreachable
+        process — the poller turns that into a scrape-error counter."""
+        if not self.url:
+            return None
+        with urllib.request.urlopen(
+            f"{self.url}/metrics", timeout=timeout
+        ) as resp:
+            return resp.read().decode("utf-8", errors="replace")
+
+    def fetch_trace(self, timeout: float = 5.0) -> Optional[dict]:
+        """The replica's ``GET /trace`` payload (span buffer + clock
+        identity); None for in-process replicas (their spans are
+        already in the router's own buffer)."""
+        if not self.url:
+            return None
+        with urllib.request.urlopen(
+            f"{self.url}/trace", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read())
+
+    def fetch_flight(self, timeout: float = 5.0) -> Optional[dict]:
+        """The replica's live flight-recorder payload (``GET /flight``);
+        None for in-process replicas (one process, one recorder — the
+        router's own dump already has it)."""
+        if not self.url:
+            return None
+        with urllib.request.urlopen(
+            f"{self.url}/flight", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read())
 
     def placeable(self) -> bool:
         """In the placement pool at all: alive, not draining for a
@@ -231,6 +319,10 @@ class RouterMetrics:
         self.migrations_corrupt_total = 0
         self.shed_total = 0
         self.flaps_damped_total = 0
+        # Fleet plane: federation scrapes that failed (per replica) and
+        # incident bundles assembled.
+        self.scrape_errors_total: Dict[str, int] = {}
+        self.incidents_total = 0
 
     def record_request(self, replica: str, role: str) -> None:
         with self._lock:
@@ -266,6 +358,16 @@ class RouterMetrics:
         with self._lock:
             self.flaps_damped_total += 1
 
+    def record_scrape_error(self, replica: str) -> None:
+        with self._lock:
+            self.scrape_errors_total[replica] = (
+                self.scrape_errors_total.get(replica, 0) + 1
+            )
+
+    def record_incident(self) -> None:
+        with self._lock:
+            self.incidents_total += 1
+
     def record_error(self) -> None:
         with self._lock:
             self.errors_total += 1
@@ -289,6 +391,10 @@ class RouterMetrics:
                 "migrations_corrupt_total": self.migrations_corrupt_total,
                 "shed_total": self.shed_total,
                 "flaps_damped_total": self.flaps_damped_total,
+                "scrape_errors_total": dict(sorted(
+                    self.scrape_errors_total.items()
+                )),
+                "incidents_total": self.incidents_total,
                 "errors_total": self.errors_total,
                 "replica_healthy": dict(sorted(
                     self.replica_healthy.items()
@@ -318,7 +424,10 @@ class Router:
                  hedge_quantile: float = 0.99,
                  hedge_factor: float = 1.5,
                  hedge_min_s: float = 0.05,
-                 degradation: Optional[DegradationConfig] = None):
+                 degradation: Optional[DegradationConfig] = None,
+                 metrics_scrape_interval: float = 1.0,
+                 incident_dir: Optional[str] = None,
+                 incident_min_interval_s: float = 30.0):
         """Hardening knobs (docs/serving.md "Surviving overload"):
 
         ``unhealthy_after``: consecutive FAILED health polls before a
@@ -336,7 +445,15 @@ class Router:
         with an explicit seed — the duplicate then computes identical
         bytes, so the race cannot change the output).  ``degradation``
         configures the router's :class:`DegradationLadder`
-        (``router.ladder``) applied fleet-wide."""
+        (``router.ladder``) applied fleet-wide.
+
+        Fleet observability plane (docs/observability.md "Fleet
+        plane"): ``metrics_scrape_interval`` paces the health poller's
+        piggybacked ``/metrics`` scrape per replica (the federated
+        exposition re-exports the latest snapshot);
+        ``incident_dir``/``incident_min_interval_s`` place and throttle
+        the ``incident_<ts>/`` bundles assembled on watchdog trips,
+        replica deaths, deploy rollbacks and autoscaler repairs."""
         if not replicas:
             raise ValueError("router needs at least one replica")
         if unhealthy_after < 1:
@@ -412,6 +529,14 @@ class Router:
             lambda: [r.server for r in self._replicas.values()],
             config=degradation, name="router",
         )
+        # Fleet observability plane state: scrape pacing, incident
+        # bundle placement + rate limit (one storm, one bundle).
+        self.metrics_scrape_interval = float(metrics_scrape_interval)
+        self.incident_dir = incident_dir
+        self.incident_min_interval_s = float(incident_min_interval_s)
+        self._incident_lock = threading.Lock()
+        self._last_incident_at = 0.0
+        self.last_incident_path: Optional[str] = None
         self._reindex_replicas()
         self._rebuild_ring()
         self._busy_polls = 0
@@ -511,14 +636,18 @@ class Router:
                deadline: Optional[float] = None,
                tenant: str = "default", priority: int = 0,
                session: Optional[str] = None,
-               adapter: Optional[str] = None) -> TokenStream:
+               adapter: Optional[str] = None,
+               trace: Optional[dict] = None) -> TokenStream:
         """Route one request (thread-safe).  The returned stream is the
         same surface ``Server.submit`` gives — tokens arrive as the
         serving replicas produce them, across migration and
         redistribution transparently.  ``session`` pins the request's
         decode to a sticky replica for multi-turn streams; ``adapter``
         names the LoRA adapter (the affinity hash includes it, so
-        same-adapter traffic lands where the adapter is resident)."""
+        same-adapter traffic lands where the adapter is resident);
+        ``trace`` is an inbound trace context (``X-Trace-Context``) —
+        absent one, the router originates the context itself, so every
+        request's cross-process spans share one trace id."""
         if self._stopping:
             raise RuntimeError("router is closed")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -564,6 +693,13 @@ class Router:
             eos_token_id=eos_token_id, deadline=deadline,
             tenant=tenant, priority=int(priority), adapter=adapter,
         )
+        # Trace origin: the router's creq id is the fleet-wide trace id
+        # unless the client already carries one — every shadow attempt,
+        # migration hop and adoption stamps its spans with this context.
+        ctx = dict(trace) if trace else {}
+        ctx.setdefault("trace_id", creq.id)
+        ctx.setdefault("origin_pid", os.getpid())
+        creq.trace_ctx = ctx
         creq.observer = self.slo.observe
         self.slo.track(creq)
         threading.Thread(
@@ -579,6 +715,19 @@ class Router:
             timeout=timeout
         )
 
+    @staticmethod
+    def _serving_replica(creq: Request) -> Optional[str]:
+        """The replica that carried (or is carrying) the DECODE of this
+        request — the most recent migration/adoption/placement mark on
+        its event log; None before placement."""
+        for ev in reversed(creq.events):
+            kind = ev.get("event")
+            if kind in ("kv_migrated", "evac_adopted"):
+                return ev.get("to")
+            if kind == "routed":
+                return ev.get("decode")
+        return None
+
     def kill_replica(self, name: str) -> None:
         """Kill a replica (tests/chaos): the replica fails its
         in-flight work with structured errors — which the router
@@ -593,6 +742,7 @@ class Router:
         if kill is not None:
             kill()
         rep.server._mark_unhealthy(f"replica '{name}' killed")
+        self.trigger_incident(f"replica_killed: {name}", dead=(name,))
 
     # -- fleet management (serving/autoscaler.py) -------------------------
 
@@ -838,7 +988,9 @@ class Router:
                     k: rep.last_health.get(k)
                     for k in ("active_slots", "queue_depth",
                               "kv_pages_free", "adoptions_pending",
-                              "adapters_resident")
+                              "adapters_resident",
+                              "compile_events_post_warmup_total",
+                              "degradation_level")
                 },
             }
             for name, rep in self._replicas.items()
@@ -1093,6 +1245,11 @@ class Router:
         )
         shadow.tokens = [int(t) for t in committed]
         shadow.preemptions = creq.preemptions
+        # The shadow gets a FRESH id per attempt; the trace context is
+        # what keeps its spans on the originating request's causal
+        # track across processes.
+        if creq.trace_ctx:
+            shadow.trace_ctx = dict(creq.trace_ctx)
         return shadow
 
     def _serve(self, creq: Request, session: Optional[str]) -> None:
@@ -1445,6 +1602,7 @@ class Router:
         for rep in candidates:
             if not rep.try_place():
                 continue
+            wire_t0 = time.monotonic()
             payload = transfer.to_bytes(export)
             plan = active_plan()
             if plan is not None:
@@ -1491,6 +1649,17 @@ class Router:
             creq.mark(
                 "kv_migrated", to=rep.name, kv_bytes=len(payload),
                 pages=export.n_pages,
+            )
+            # The wire hop on the ROUTER's trace lane: serialize ->
+            # adopted, bridging the prefill lane's span to the decode
+            # lane's in the merged fleet timeline.
+            ctx = creq.trace_ctx or {}
+            spans.complete_event(
+                f"kv_wire {ctx.get('trace_id', creq.id)}",
+                wire_t0, time.monotonic(), category="router",
+                request=creq.id,
+                trace_id=ctx.get("trace_id", creq.id),
+                to=rep.name, kv_bytes=len(payload),
             )
             return True
         shadow.error = (
@@ -1555,8 +1724,16 @@ class Router:
                         "router_replica_unhealthy", replica=rep.name,
                         reason=payload.get("reason"),
                     )
+                    # Watchdog trip / engine death / severed process:
+                    # capture the fleet's state while it is still warm.
+                    self.trigger_incident(
+                        f"replica_unhealthy: {rep.name}: "
+                        f"{payload.get('reason')}",
+                        dead=(rep.name,),
+                    )
                 rep.healthy = ok
                 self.metrics.set_replica_health(rep.name, ok)
+            self.scrape_metrics()
             self._stop_event.wait(self._health_interval)
 
     def _fire_chaos_kill(self) -> None:
@@ -1640,6 +1817,29 @@ class Router:
             "router_flaps_damped_total",
             "failed health polls absorbed by flap damping",
         ).set(float(snap["flaps_damped_total"]))
+        scrape_err = r.gauge(
+            "router_replica_scrape_errors_total",
+            "federation /metrics scrapes that failed, by replica",
+            labelnames=("replica",),
+        )
+        for name, n in snap["scrape_errors_total"].items():
+            scrape_err.labels(replica=name).set(float(n))
+        r.gauge(
+            "router_incidents_total",
+            "incident bundles assembled (throttled triggers excluded)",
+        ).set(float(snap["incidents_total"]))
+        clock = r.gauge(
+            "router_replica_clock_shift_us",
+            "per-replica trace-clock shift onto the router's clock "
+            "(epoch-exact or NTP-handshake estimate)",
+            labelnames=("replica", "method"),
+        )
+        for name, rep in self._replicas.items():
+            shift, method = federation.resolve_clock_shift(
+                rep.epoch_shift_us, rep.ntp_shift_us, rep.ntp_rtt_us
+            )
+            if shift is not None:
+                clock.labels(replica=name, method=method).set(shift)
         breaker = r.gauge(
             "router_breaker_state",
             "per-replica circuit breaker (0 closed, 1 half-open, 2 open)",
@@ -1668,6 +1868,241 @@ class Router:
                 )
         self.slo.publish(r)
         return snap
+
+    # -- fleet observability plane ----------------------------------------
+    # (docs/observability.md "Fleet plane": metrics federation, merged
+    # cross-process traces, incident bundles.)
+
+    def scrape_metrics(self, force: bool = False) -> None:
+        """One federation sweep: fetch each url-replica's raw
+        ``/metrics`` text (paced by ``metrics_scrape_interval`` per
+        replica unless ``force``).  A failed scrape bumps
+        ``router_replica_scrape_errors_total{replica=}`` and keeps the
+        last good snapshot — the poller never crashes on a dead
+        process."""
+        now = time.monotonic()
+        for rep in self._replicas.values():
+            if not rep.url:
+                continue
+            if (
+                not force
+                and now - rep.metrics_scraped_at
+                < self.metrics_scrape_interval
+            ):
+                continue
+            rep.metrics_scraped_at = now
+            try:
+                rep.metrics_text = rep.fetch_metrics_text()
+            except Exception as e:  # noqa: BLE001 — scrape is best effort
+                self.metrics.record_scrape_error(rep.name)
+                self._log.info(
+                    "router_metrics_scrape_failed", replica=rep.name,
+                    error=str(e),
+                )
+
+    def federated_metrics_text(self,
+                               base_text: Optional[str] = None) -> str:
+        """ONE Prometheus exposition for the whole fleet: the router's
+        own registry plus every worker's latest scraped snapshot, each
+        worker series re-labeled ``replica=``/``role=``/``generation=``
+        (telemetry/federation.py).  Rendering always starts from the
+        latest snapshots — replace, never accumulate — so scraping the
+        router twice between worker scrapes returns identical bytes
+        (no histogram double-counting)."""
+        if base_text is None:
+            from ml_trainer_tpu.telemetry.registry import default_registry
+
+            registry = default_registry()
+            self.publish(registry)
+            base_text = registry.prometheus_text()
+        sections = []
+        for name, rep in sorted(self._replicas.items()):
+            if rep.metrics_text is None:
+                continue
+            sections.append((rep.metrics_text, {
+                "replica": name, "role": rep.role,
+                "generation": str(rep.generation),
+            }))
+        return federation.federate_exposition(base_text, sections)
+
+    def fleet_trace(self) -> dict:
+        """The merged, clock-aligned Perfetto document: the router's
+        own span buffer plus every reachable url-replica's ``GET
+        /trace`` payload, each worker lane shifted onto the router's
+        trace clock by the health poller's handshake estimates.  An
+        unreachable replica is skipped (its lane is simply absent);
+        an in-process replica needs no fetch — its spans already live
+        in the router's buffer."""
+        remotes = []
+        for name, rep in sorted(self._replicas.items()):
+            if not rep.url:
+                continue
+            try:
+                payload = rep.fetch_trace()
+            except Exception:  # noqa: BLE001 — dead process, no lane
+                continue
+            remotes.append({
+                "name": name, "payload": payload,
+                "epoch_shift_us": rep.epoch_shift_us,
+                "ntp_shift_us": rep.ntp_shift_us,
+                "rtt_us": rep.ntp_rtt_us,
+            })
+        return federation.merge_fleet_trace(
+            spans.trace_events(), "router", os.getpid(), remotes
+        )
+
+    def save_fleet_trace(self, path: str) -> str:
+        """Write :meth:`fleet_trace` as a ``chrome://tracing`` /
+        Perfetto JSON file (atomic)."""
+        doc = self.fleet_trace()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fp:
+            json.dump(doc, fp, default=str)
+        os.replace(tmp, path)
+        self._log.info(
+            "router_fleet_trace_saved", path=path,
+            events=len(doc["traceEvents"]),
+        )
+        return path
+
+    def trigger_incident(self, reason: str,
+                         dead: Sequence[str] = ()) -> None:
+        """Fire-and-forget incident bundle assembly off the calling
+        thread (the poller/kill paths must never block on N replica
+        fetches).  Throttled inside :meth:`save_incident_bundle`."""
+        threading.Thread(
+            target=self._trigger_incident_body, args=(reason, tuple(dead)),
+            daemon=True, name="router-incident",
+        ).start()
+
+    def _trigger_incident_body(self, reason: str,
+                               dead: Tuple[str, ...]) -> None:
+        try:
+            self.save_incident_bundle(reason, dead=dead)
+        except Exception as e:  # noqa: BLE001 — forensics never kill serving
+            self._log.error("router_incident_failed", error=str(e))
+
+    def save_incident_bundle(self, reason: str,
+                             dead: Sequence[str] = (),
+                             out_dir: Optional[str] = None,
+                             force: bool = False) -> Optional[str]:
+        """Assemble ``incident_<ts>_<pid>/`` — everything a post-mortem
+        needs, captured while the fleet's state is still warm:
+
+        * ``flight_router.json`` — the router process's flight payload;
+        * ``flight_<replica>.json`` — each reachable url-replica's live
+          flight payload (``GET /flight``; a dead process is skipped);
+        * ``slo_timelines.json`` — the router tracker's last retained
+          per-request timelines;
+        * ``metrics.prom`` / ``router.json`` — the federated exposition
+          and the router snapshot at capture time;
+        * ``stderr_<replica>.txt`` — the dead workers' combined
+          stdout+stderr tails (fleet workers only);
+        * ``manifest.json`` — reason, trigger set, fleet health, files.
+
+        Bundles are throttled (``incident_min_interval_s``) unless
+        ``force`` — a flapping replica must not write one per poll.
+        Directory resolves: ``out_dir`` arg, router ``incident_dir``,
+        ``ML_TRAINER_TPU_INCIDENT_DIR``, the system temp dir.  Returns
+        the bundle path, or None when throttled."""
+        now = time.monotonic()
+        with self._incident_lock:
+            if (
+                not force
+                and now - self._last_incident_at
+                < self.incident_min_interval_s
+                and self._last_incident_at > 0.0
+            ):
+                return None
+            self._last_incident_at = now
+        d = (
+            out_dir or self.incident_dir
+            or os.environ.get(INCIDENT_DIR_ENV)
+            or tempfile.gettempdir()
+        )
+        stem = os.path.join(
+            d,
+            f"incident_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}",
+        )
+        # Two incidents inside one wall-clock second (e.g. a forced
+        # bundle right after a triggered one) must not overwrite each
+        # other: uniquify with a suffix.
+        bundle, n = stem, 1
+        while True:
+            try:
+                os.makedirs(bundle, exist_ok=False)
+                break
+            except FileExistsError:
+                bundle = f"{stem}_{n}"
+                n += 1
+        files: List[str] = []
+
+        def _write(name: str, payload) -> None:
+            try:
+                path = os.path.join(bundle, name)
+                with open(path, "w", encoding="utf-8") as fp:
+                    if isinstance(payload, str):
+                        fp.write(payload)
+                    else:
+                        json.dump(payload, fp, default=str)
+                files.append(name)
+            except Exception as e:  # noqa: BLE001 — partial bundle > none
+                self._log.info(
+                    "router_incident_artifact_failed", artifact=name,
+                    error=str(e),
+                )
+
+        _write(
+            "flight_router.json",
+            get_recorder().payload(f"incident: {reason}"),
+        )
+        replica_flights: List[str] = []
+        for name, rep in sorted(self._replicas.items()):
+            try:
+                payload = rep.fetch_flight()
+            except Exception:  # noqa: BLE001 — dead process
+                continue
+            if payload is not None:
+                _write(f"flight_{name}.json", payload)
+                replica_flights.append(name)
+        _write("slo_timelines.json", self.slo.timelines())
+        _write("metrics.prom", self.federated_metrics_text())
+        _write("router.json", self.snapshot())
+        for name in dead:
+            rep = self._replicas.get(name)
+            tail_fn = getattr(
+                getattr(rep, "server", None), "stderr_tail", None
+            )
+            if tail_fn is None:
+                continue
+            try:
+                tail = tail_fn()
+            except Exception:  # noqa: BLE001
+                tail = None
+            if tail:
+                _write(f"stderr_{name}.txt", tail)
+        _write("manifest.json", {
+            "reason": reason,
+            "created_at": time.time(),
+            "dead": list(dead),
+            "replica_flights": replica_flights,
+            "health": self.health(),
+            "files": sorted(files),
+        })
+        self.metrics.record_incident()
+        get_recorder().record(
+            "incident_bundle", reason=reason, path=bundle,
+            files=len(files),
+        )
+        self._log.error(
+            "router_incident_bundle", reason=reason, path=bundle,
+            files=sorted(files),
+        )
+        with self._incident_lock:
+            self.last_incident_path = bundle
+        return bundle
 
     # -- HTTP front end ---------------------------------------------------
 
@@ -1705,13 +2140,11 @@ class Router:
                     payload = router.health()
                     self._send(200 if payload["ok"] else 503, payload)
                 elif self.path == "/metrics":
-                    from ml_trainer_tpu.telemetry.registry import (
-                        default_registry,
-                    )
-
-                    registry = default_registry()
-                    router.publish(registry)
-                    body = registry.prometheus_text().encode()
+                    # The FEDERATED exposition: the router's own
+                    # registry plus every worker's latest scraped
+                    # snapshot re-labeled replica=/role=/generation= —
+                    # one scrape covers the whole fleet.
+                    body = router.federated_metrics_text().encode()
                     self.send_response(200)
                     self.send_header(
                         "Content-Type",
@@ -1722,6 +2155,10 @@ class Router:
                     self.wfile.write(body)
                 elif self.path == "/metrics.json":
                     self._send(200, router.snapshot())
+                elif self.path == "/trace":
+                    # The merged clock-aligned fleet timeline (load it
+                    # straight into Perfetto / chrome://tracing).
+                    self._send(200, router.fleet_trace())
                 elif self.path == "/slo":
                     self._send(200, router.slo.snapshot())
                 else:
@@ -1736,7 +2173,7 @@ class Router:
                     body = json.loads(self.rfile.read(n) or b"{}")
                     session = body.get("session")
                     deadline = body.get("deadline")
-                    out = router.complete(
+                    stream = router.submit(
                         np.asarray(body["prompt"], np.int32),
                         int(body.get("max_new_tokens", 16)),
                         temperature=float(body.get("temperature", 0.0)),
@@ -1747,17 +2184,25 @@ class Router:
                         priority=int(body.get("priority", 0)),
                         session=str(session) if session else None,
                         adapter=body.get("adapter"),
-                        # The HTTP wait is capped by the client's own
-                        # deadline (plus routing slack): a deadline'd
-                        # request gets a timely 504, and the remaining
-                        # budget decrements across every redistribute
-                        # and hedge inside the router.
-                        timeout=(
-                            float(deadline) + 30.0
-                            if deadline is not None else None
-                        ),
+                        trace=_trace_ctx_header(self.headers),
                     )
-                    self._send(200, {"tokens": [int(t) for t in out]})
+                    # The HTTP wait is capped by the client's own
+                    # deadline (plus routing slack): a deadline'd
+                    # request gets a timely 504, and the remaining
+                    # budget decrements across every redistribute
+                    # and hedge inside the router.
+                    out = stream.result(timeout=(
+                        float(deadline) + 30.0
+                        if deadline is not None else None
+                    ))
+                    self._send(200, {
+                        "tokens": [int(t) for t in out],
+                        # Which replica actually served the decode —
+                        # the last migration/placement mark on the
+                        # request's event log (loadgen attributes its
+                        # latency rows by this).
+                        "replica": router._serving_replica(stream._req),
+                    })
                 except OverloadShed as e:
                     payload = {"error": str(e)}
                     if e.retry_after is not None:

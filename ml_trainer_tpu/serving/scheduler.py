@@ -158,6 +158,15 @@ class Request:
     # place — the router adopts the request into a decode replica.
     # Cleared at export so a later preempt-resume decodes where it is.
     migration_sink: object = None
+    # Fleet trace context (docs/observability.md "Fleet plane"): set by
+    # the origin process (the router front end) and carried across every
+    # RPC hop (/v1/stream body + /v1/adopt wire meta + X-Trace-Context
+    # header), so each process's retrospective request spans share one
+    # ``trace_id`` and the merged fleet timeline renders a migrated
+    # request as a single causally-ordered track.  Keys: ``trace_id``
+    # (the ORIGIN request id — shadows/adoptions mint fresh local ids),
+    # ``parent`` (the span that emitted this hop), ``origin_pid``.
+    trace_ctx: Optional[dict] = None
     # Overload control (serving/overload.py): ``retry_after`` rides a
     # shed request's structured 503 (state == "shed"); the router's
     # hedging path sets ``cancel_requested`` on the losing duplicate so

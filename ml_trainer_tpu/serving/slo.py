@@ -34,6 +34,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
+import time
 from typing import Dict, Optional
 
 from ml_trainer_tpu.serving.metrics import ServingMetrics
@@ -218,6 +219,24 @@ class SloTracker:
         if self._trace:
             self._emit_trace(req, tl)
 
+    @staticmethod
+    def _trace_args(req, tl: dict) -> dict:
+        """The per-span args correlating this process's fragment with
+        the fleet-wide request (docs/observability.md "Fleet plane"):
+        ``trace_id`` is the ORIGIN request id when a trace context rode
+        the wire (shadows/adoptions mint fresh local ids), else the
+        local id — single-process traces are unchanged."""
+        ctx = getattr(req, "trace_ctx", None) or {}
+        args = {
+            "request": tl["id"],
+            "trace_id": ctx.get("trace_id", tl["id"]),
+        }
+        if ctx.get("origin_pid") is not None:
+            args["origin_pid"] = ctx["origin_pid"]
+        if ctx.get("parent"):
+            args["parent"] = ctx["parent"]
+        return args
+
     def _emit_trace(self, req, tl: dict) -> None:
         """Render the finished request as nested retrospective spans on
         the process trace: one ``request N`` complete event spanning
@@ -229,31 +248,70 @@ class SloTracker:
         fin = req.finished_at
         if fin is None or fin <= sub:
             return
+        targs = self._trace_args(req, tl)
         spans.complete_event(
-            f"request {tl['id']}", sub, fin, category="request",
-            request=tl["id"], tenant=tl["tenant"], state=tl["state"],
+            f"request {targs['trace_id']}", sub, fin, category="request",
+            tenant=tl["tenant"], state=tl["state"],
             prompt_tokens=tl["prompt_tokens"],
             new_tokens=tl["new_tokens"],
-            preemptions=tl["preemptions"],
+            preemptions=tl["preemptions"], **targs,
         )
         admit = req.first_admitted_at
         first_tok = req.first_token_at
         if admit is not None and admit > sub:
             spans.complete_event(
                 "queue_wait", sub, min(admit, fin), category="request",
-                request=tl["id"],
+                **targs,
             )
         if admit is not None and first_tok is not None \
                 and first_tok > admit:
             spans.complete_event(
                 "prefill", admit, min(first_tok, fin),
-                category="request", request=tl["id"],
-                prefix_hit_tokens=tl["prefix_hit_tokens"],
+                category="request",
+                prefix_hit_tokens=tl["prefix_hit_tokens"], **targs,
             )
         if first_tok is not None and fin > first_tok:
             spans.complete_event(
                 "decode", first_tok, fin, category="request",
-                request=tl["id"], new_tokens=tl["new_tokens"],
+                new_tokens=tl["new_tokens"], **targs,
+            )
+
+    def observe_export(self, req) -> None:
+        """Emit the PREFILL-SIDE spans for a request migrating away
+        (``Server._export_for_migration``): the request never finishes
+        on this replica — ``forget()`` drops it without a timeline — so
+        without this call the fleet trace would have a hole where the
+        prefill happened.  Emits ``queue_wait`` and ``prefill`` children
+        plus a ``request N (prefill)`` envelope ending at export, all
+        stamped with the wire trace context so the decode replica's
+        fragment and this one share a ``trace_id`` on the merged
+        timeline.  No SLO accounting moves — attainment for a migrated
+        request is billed exactly once, by the decode-side tracker."""
+        if not self._trace:
+            return
+        from ml_trainer_tpu.telemetry import spans
+
+        tl = req.timeline()
+        targs = self._trace_args(req, tl)
+        sub = req.submitted_at
+        now = time.monotonic()
+        if now <= sub:
+            return
+        spans.complete_event(
+            f"request {targs['trace_id']} (prefill)", sub, now,
+            category="request", tenant=tl["tenant"], state="migrated_out",
+            prompt_tokens=tl["prompt_tokens"], **targs,
+        )
+        admit = req.first_admitted_at
+        if admit is not None and admit > sub:
+            spans.complete_event(
+                "queue_wait", sub, min(admit, now), category="request",
+                **targs,
+            )
+        if admit is not None and now > admit:
+            spans.complete_event(
+                "prefill", admit, now, category="request",
+                prefix_hit_tokens=tl["prefix_hit_tokens"], **targs,
             )
 
     # -- reading ---------------------------------------------------------

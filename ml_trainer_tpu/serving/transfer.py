@@ -442,7 +442,7 @@ def request_wire_meta(req) -> dict:
     deadline = None
     if req.deadline is not None:
         deadline = max(req.deadline_at - time.monotonic(), 0.001)
-    return {
+    meta = {
         "id": int(req.id),
         "prompt": [int(t) for t in np.asarray(req.prompt).reshape(-1)],
         "max_new_tokens": int(req.max_new_tokens),
@@ -457,6 +457,14 @@ def request_wire_meta(req) -> dict:
         "adapter": req.adapter,
         "tokens": [int(t) for t in req.tokens],
     }
+    # Fleet trace context (docs/observability.md "Fleet plane"): the
+    # origin request id + pid ride every hop, so the receiving process
+    # stamps ITS retrospective spans with the same trace_id and the
+    # merged fleet timeline correlates the fragments.
+    trace = getattr(req, "trace_ctx", None)
+    if trace:
+        meta["trace"] = dict(trace)
+    return meta
 
 
 def request_from_wire(meta: dict):
@@ -481,6 +489,9 @@ def request_from_wire(meta: dict):
         adapter=meta.get("adapter"),
     )
     req.tokens = [int(t) for t in meta.get("tokens", [])]
+    trace = meta.get("trace")
+    if trace:
+        req.trace_ctx = dict(trace)
     return req
 
 

@@ -191,6 +191,20 @@ def install() -> str:
         if _state.installed:
             return _state.mode
         _state.installed = True
+    # Register the post-warmup counter eagerly (at 0): the fleet's
+    # metrics federation (serving/router.py) pins every worker's
+    # ``compile_events_post_warmup_total`` in the merged exposition —
+    # absence must mean "watch not installed", never "no recompile yet".
+    try:
+        from ml_trainer_tpu.telemetry.registry import default_registry
+
+        default_registry().counter(
+            "compile_events_post_warmup_total",
+            "compiles AFTER the owning loop declared warmup done — "
+            "each one is a steady-state recompile to investigate",
+        )
+    except Exception:
+        pass
     import jax
 
     mode = "monitoring"

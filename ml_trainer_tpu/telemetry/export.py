@@ -114,16 +114,39 @@ _default_sink: Optional[JsonlSink] = None
 _sink_lock = threading.Lock()
 
 SINK_ENV = "ML_TRAINER_TPU_METRICS_JSONL"
+# Set by the fleet launcher (serving/fleet.py spawn): each worker
+# process inherits the driver's SINK_ENV path, and N workers appending
+# to ONE file interleave lines mid-record.  The worker id (or, for any
+# other multi-process launcher, "pid") suffixes the sink path per
+# process: `metrics.jsonl` -> `metrics.<worker>.jsonl` — one file per
+# process, same directory, `jq`-able as a glob.
+SINK_WORKER_ENV = "ML_TRAINER_TPU_METRICS_WORKER"
+
+
+def sink_path_for_worker(path: str, worker: str) -> str:
+    """``path`` with a per-worker suffix before the extension (or
+    appended when there is none): the fleet sink layout."""
+    base, ext = os.path.splitext(path)
+    return f"{base}.{worker}{ext}" if ext else f"{path}.{worker}"
 
 
 def default_sink() -> Optional[JsonlSink]:
     """Process-wide JSONL sink, enabled by pointing the env var
-    ``ML_TRAINER_TPU_METRICS_JSONL`` at a file path; None when unset."""
+    ``ML_TRAINER_TPU_METRICS_JSONL`` at a file path; None when unset.
+    When ``ML_TRAINER_TPU_METRICS_WORKER`` is also set (fleet worker
+    processes), the path gains a per-worker suffix so concurrent
+    workers never interleave writes into one file (``pid`` as the
+    worker id gives the same isolation to ad-hoc launchers)."""
     global _default_sink
     path = os.environ.get(SINK_ENV, "")
+    worker = os.environ.get(SINK_WORKER_ENV, "")
     with _sink_lock:
         if not path:
             return None
+        if worker:
+            path = sink_path_for_worker(
+                path, worker if worker != "pid" else str(os.getpid())
+            )
         if _default_sink is None or _default_sink.path != path:
             _default_sink = JsonlSink(path)
         return _default_sink

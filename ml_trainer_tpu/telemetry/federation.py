@@ -1,0 +1,304 @@
+"""Fleet observability plane: metrics federation + cross-process trace
+merge (docs/observability.md "Fleet plane").
+
+A multi-process serving fleet (serving/fleet.py) has N worker processes,
+each with its OWN metrics registry, span buffer, and flight recorder —
+process-local instruments the router cannot see.  This module is the
+pure, process-free core of the fleet plane:
+
+* **Metrics federation** (:func:`relabel_exposition`,
+  :func:`federate_exposition`): rewrite a worker's Prometheus text
+  exposition (the exact 0.0.4 bytes its ``/metrics`` served) so every
+  sample line carries ``replica=``/``role=``/``generation=`` labels,
+  then merge the rewritten sections with the router's own exposition
+  into ONE valid document — ``# HELP``/``# TYPE`` deduplicated, all
+  samples of a metric family grouped.  The router re-renders from each
+  replica's LATEST scraped snapshot on every ``/metrics`` hit (replace,
+  never accumulate), so re-scraping is idempotent: histogram counts are
+  whatever the worker last reported, not a running sum of scrapes.
+
+* **Trace merge** (:func:`shift_trace_events`, :func:`merge_fleet_trace`):
+  place N processes' Chrome trace events on ONE timeline.  Each worker's
+  timestamps are microseconds since ITS OWN epoch (telemetry/spans.py
+  ``_MONO_EPOCH``), so merging needs a per-process clock shift onto the
+  router's trace clock.  Two estimates exist per worker (computed by the
+  router's health poller, serving/router.py): the *epoch shift* — exact
+  when both processes read the same underlying clock, which
+  ``time.monotonic()`` (CLOCK_MONOTONIC) is across processes on Linux —
+  and the *NTP-style handshake* estimate (worker reports its trace-clock
+  "now" inside the health payload; the router brackets the call with its
+  own stamps and maps the report to the bracket's midpoint, error
+  bounded by rtt/2, min-rtt filtered across polls).  The merge prefers
+  the epoch shift when the two agree within the handshake's error bound
+  (shared clock confirmed) and falls back to the handshake estimate
+  otherwise (distinct clocks — e.g. a future multi-host fleet).
+
+Host-only module: no jax, no sockets — callers feed it text/dicts they
+already fetched.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+# Labels the federation layer owns.  A worker series that already
+# carries one of these (it should never) keeps its own value — injecting
+# a duplicate label name would make the exposition invalid.
+FEDERATION_LABELS = ("replica", "role", "generation")
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{.*\})?"
+    r"(?P<rest>\s.+)$"
+)
+
+# Histogram/summary child-sample suffixes: `x_bucket`/`x_sum`/`x_count`
+# belong to family `x` — grouping must keep them with their TYPE header.
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _escape(v) -> str:
+    s = str(v)
+    return s.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+
+def inject_labels(line: str, extra: Dict[str, object]) -> str:
+    """One exposition sample line with ``extra`` labels appended.
+
+    Comment/blank lines pass through untouched.  Existing labels win on
+    a name collision (the injected pair is dropped, not duplicated)."""
+    if not line or line.startswith("#"):
+        return line
+    m = _SAMPLE_RE.match(line)
+    if m is None:
+        return line
+    existing = m.group("labels") or ""
+    pairs = [
+        f'{k}="{_escape(v)}"'
+        for k, v in extra.items()
+        if f'{k}="' not in existing
+    ]
+    if not pairs:
+        return line
+    if existing:
+        inner = existing[1:-1]
+        merged = "{" + (inner + "," if inner else "") + ",".join(pairs) + "}"
+    else:
+        merged = "{" + ",".join(pairs) + "}"
+    return f"{m.group('name')}{merged}{m.group('rest')}"
+
+
+def relabel_exposition(text: str, extra: Dict[str, object]) -> str:
+    """A whole Prometheus text exposition with ``extra`` labels injected
+    into every sample line (``# HELP``/``# TYPE`` untouched)."""
+    return "\n".join(
+        inject_labels(line, extra) for line in text.splitlines()
+    ) + ("\n" if text.endswith("\n") else "")
+
+
+def parse_exposition(text: str) -> List[dict]:
+    """Exposition text as metric-family groups, document order:
+    ``[{"name", "help", "type", "samples": [line, ...]}, ...]``.
+
+    Grouping follows the comment headers: sample lines after a
+    ``# TYPE x ...`` belong to family ``x`` until the next header; a
+    bare sample with no header becomes its own untyped family (help and
+    type ``None``), with histogram child suffixes folded into the base
+    name so ``x_bucket``/``x_sum``/``x_count`` stay together."""
+    families: List[dict] = []
+    by_name: Dict[str, dict] = {}
+    current: Optional[dict] = None
+
+    def _family(name: str, help_text=None, kind=None) -> dict:
+        fam = by_name.get(name)
+        if fam is None:
+            fam = {"name": name, "help": help_text, "type": kind,
+                   "samples": []}
+            by_name[name] = fam
+            families.append(fam)
+        else:
+            if help_text is not None and fam["help"] is None:
+                fam["help"] = help_text
+            if kind is not None and fam["type"] is None:
+                fam["type"] = kind
+        return fam
+
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line[len("# HELP "):].split(" ", 1)
+            current = _family(
+                parts[0], help_text=parts[1] if len(parts) > 1 else ""
+            )
+            continue
+        if line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split(" ", 1)
+            current = _family(
+                parts[0], kind=parts[1].strip() if len(parts) > 1 else None
+            )
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        name = m.group("name")
+        base = name
+        for suf in _FAMILY_SUFFIXES:
+            if name.endswith(suf):
+                base = name[: -len(suf)]
+                break
+        if current is not None and (
+            name == current["name"] or base == current["name"]
+        ):
+            current["samples"].append(line)
+        else:
+            _family(base if base in by_name else name)["samples"].append(
+                line
+            )
+            current = by_name.get(base, by_name.get(name))
+    return families
+
+
+def federate_exposition(
+    base_text: str,
+    sections: Sequence[Tuple[str, Dict[str, object]]],
+) -> str:
+    """ONE valid exposition document from the router's own text plus N
+    scraped worker snapshots.
+
+    ``sections`` is ``[(worker_exposition_text, extra_labels), ...]`` —
+    each worker's text is relabeled (:func:`relabel_exposition`) and
+    merged family-by-family with ``base_text``: one ``# HELP``/``# TYPE``
+    header per metric name (first writer wins), every family's samples
+    grouped regardless of which process reported them.  Rendering always
+    starts from the LATEST snapshots, so calling this twice with the
+    same inputs returns the same bytes — the idempotent-re-scrape
+    property the federation tests pin."""
+    merged: List[dict] = []
+    by_name: Dict[str, dict] = {}
+    for text in [base_text] + [
+        relabel_exposition(text, extra) for text, extra in sections
+    ]:
+        for fam in parse_exposition(text):
+            have = by_name.get(fam["name"])
+            if have is None:
+                fam = dict(fam, samples=list(fam["samples"]))
+                by_name[fam["name"]] = fam
+                merged.append(fam)
+            else:
+                if have["help"] is None:
+                    have["help"] = fam["help"]
+                if have["type"] is None:
+                    have["type"] = fam["type"]
+                have["samples"].extend(fam["samples"])
+    lines: List[str] = []
+    for fam in merged:
+        if fam["help"]:
+            lines.append(f"# HELP {fam['name']} {fam['help']}")
+        if fam["type"]:
+            lines.append(f"# TYPE {fam['name']} {fam['type']}")
+        lines.extend(fam["samples"])
+    return "\n".join(lines) + "\n"
+
+
+# -- cross-process trace merge -------------------------------------------
+
+
+def resolve_clock_shift(
+    epoch_shift_us: Optional[float],
+    ntp_shift_us: Optional[float],
+    rtt_us: Optional[float],
+) -> Tuple[Optional[float], str]:
+    """The per-process shift (µs to ADD to a worker event's ``ts`` to
+    land it on the router's trace clock) and which estimate won.
+
+    The epoch shift is exact when both processes share the underlying
+    monotonic clock; the NTP handshake bounds its own error by rtt/2.
+    So: when both exist and agree within the handshake's error bound
+    (plus 1ms slack for scheduling between the stamps), the clocks are
+    shared — use the exact epoch shift.  Disagreement means distinct
+    clocks — trust the handshake.  Returns ``(None, "none")`` when no
+    estimate exists (never health-polled)."""
+    if epoch_shift_us is None and ntp_shift_us is None:
+        return None, "none"
+    if ntp_shift_us is None:
+        return epoch_shift_us, "epoch"
+    if epoch_shift_us is None:
+        return ntp_shift_us, "ntp"
+    bound = (rtt_us or 0.0) / 2.0 + 1_000.0
+    if abs(epoch_shift_us - ntp_shift_us) <= bound:
+        return epoch_shift_us, "epoch"
+    return ntp_shift_us, "ntp"
+
+
+def shift_trace_events(events: Iterable[dict],
+                       shift_us: float) -> List[dict]:
+    """Copies of ``events`` with ``ts`` shifted onto the merged clock
+    (``dur`` and everything else untouched)."""
+    out = []
+    for ev in events:
+        ev = dict(ev)
+        if "ts" in ev:
+            ev["ts"] = float(ev["ts"]) + shift_us
+        out.append(ev)
+    return out
+
+
+def process_name_events(pid: int, name: str) -> List[dict]:
+    """Perfetto metadata events labeling one process lane."""
+    return [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": name},
+    }]
+
+
+def merge_fleet_trace(local_events: Sequence[dict],
+                      local_name: str,
+                      local_pid: int,
+                      remotes: Sequence[dict]) -> dict:
+    """One clock-aligned Perfetto timeline from the router's own span
+    buffer plus N remote ``GET /trace`` payloads.
+
+    Each remote entry: ``{"name", "payload", "epoch_shift_us",
+    "ntp_shift_us", "rtt_us"}`` where ``payload`` is the worker's
+    ``/trace`` reply (``{"pid", "events", ...}``).  Events keep their
+    originating pid — Perfetto renders one lane per process — and every
+    lane gets a ``process_name`` metadata row.  Returns
+    ``{"traceEvents": [...], "displayTimeUnit": "ms", "fleetClock":
+    {per-process shift/method/rtt}}``; a remote with no usable clock
+    estimate contributes its lane UNSHIFTED and is flagged
+    ``method="none"`` in ``fleetClock`` (visible, not silently
+    dropped)."""
+    events: List[dict] = list(local_events)
+    events.extend(process_name_events(local_pid, local_name))
+    clock: Dict[str, dict] = {
+        local_name: {"shift_us": 0.0, "method": "local", "pid": local_pid},
+    }
+    for rem in remotes:
+        payload = rem.get("payload") or {}
+        pid = payload.get("pid")
+        shift, method = resolve_clock_shift(
+            rem.get("epoch_shift_us"), rem.get("ntp_shift_us"),
+            rem.get("rtt_us"),
+        )
+        clock[rem["name"]] = {
+            "shift_us": round(shift, 3) if shift is not None else None,
+            "method": method,
+            "rtt_us": rem.get("rtt_us"),
+            "pid": pid,
+        }
+        evs = payload.get("events") or []
+        events.extend(
+            shift_trace_events(evs, shift) if shift is not None
+            else [dict(e) for e in evs]
+        )
+        if pid is not None:
+            events.extend(process_name_events(pid, rem["name"]))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "fleetClock": clock,
+    }

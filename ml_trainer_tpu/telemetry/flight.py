@@ -97,11 +97,12 @@ class FlightRecorder:
             or tempfile.gettempdir()
         )
 
-    def dump(self, reason: str, out_dir: Optional[str] = None,
-             **extra) -> Optional[str]:
-        """Write ``flight_<ts>.json`` with the ring + reason; returns the
-        path, or None when the write itself fails (a dump must never
-        take down the process it is documenting)."""
+    def payload(self, reason: str, **extra) -> dict:
+        """The full dump payload (ring + context providers) WITHOUT
+        writing it — what ``dump()`` serializes, and what the fleet
+        plane's ``GET /flight`` endpoint (serving/api.py) serves so a
+        router can pull a live worker's forensics into an incident
+        bundle without the worker touching its own disk."""
         payload = {
             "reason": reason,
             "dumped_at": time.time(),
@@ -112,6 +113,14 @@ class FlightRecorder:
         context = self._collect_context()
         if context:
             payload["context"] = context
+        return payload
+
+    def dump(self, reason: str, out_dir: Optional[str] = None,
+             **extra) -> Optional[str]:
+        """Write ``flight_<ts>.json`` with the ring + reason; returns the
+        path, or None when the write itself fails (a dump must never
+        take down the process it is documenting)."""
+        payload = self.payload(reason, **extra)
         d = self._resolve_dir(out_dir)
         path = os.path.join(
             d, f"flight_{time.strftime('%Y%m%d_%H%M%S')}_{os.getpid()}.json"

@@ -124,6 +124,30 @@ def trace_events() -> list:
         return list(_events)
 
 
+def clock_payload() -> dict:
+    """This process's trace-clock identity, JSON-safe — what the fleet
+    clock handshake exchanges (telemetry/federation.py): the trace
+    clock's "now" (the NTP-style sample a caller brackets with its own
+    stamps) and the raw monotonic epoch (exact cross-process alignment
+    when CLOCK_MONOTONIC is machine-shared, which Linux guarantees)."""
+    return {
+        "pid": os.getpid(),
+        "trace_now_us": _now_us(),
+        "mono_epoch": _MONO_EPOCH,
+    }
+
+
+def trace_payload(name: str = "") -> dict:
+    """The span buffer plus clock identity — one process's reply to the
+    fleet plane's ``GET /trace`` (serving/api.py): everything
+    ``Router.save_fleet_trace()`` needs to place this process's lane on
+    the merged timeline."""
+    payload = clock_payload()
+    payload["name"] = name
+    payload["events"] = trace_events()
+    return payload
+
+
 def clear_trace() -> None:
     with _events_lock:
         _events.clear()

@@ -127,6 +127,7 @@ _ARTIFACT_LEGS = {
     "serving_lora_cpu.json": "lora",
     "serving_chaos_cpu.json": "overload",
     "serving_fleet_cpu.json": "fleet",
+    "fleet_obs_cpu.json": "fleet",
     "serving_deploy_cpu.json": "deploy",
     "memory_goodput_cpu.json": "goodput",
     "elastic_chaos_cpu.json": "elastic",
@@ -194,6 +195,15 @@ def legs_for_changes(files) -> set:
             continue
         if path.startswith("ml_trainer_tpu/resilience/"):
             legs.update({"elastic", "overload", "fleet"})
+            continue
+        if path.startswith("ml_trainer_tpu/telemetry/"):
+            # The observability spine (registry/spans/flight/export/
+            # federation) is exercised end-to-end by the legs that
+            # read it: the SLO plane, the multi-process fleet (whose
+            # gate pins the federation/trace/bundle invariants), and
+            # the rollout gate's SLO-burn rollback.  A telemetry edit
+            # cannot move a train-step or kernel number.
+            legs.update({"slo", "fleet", "deploy"})
             continue
         if base == "graft_lint.py" and path.startswith("scripts/"):
             legs.add("lint")
@@ -909,6 +919,11 @@ def gate_fleet(threshold: float, backend: str, fp: str) -> dict:
        structured, never hangs), socket migrations actually flowed,
        chunked prefill actually engaged on the long-prompt mix, and
        the autoscaler respawned the killed worker as a fresh process.
+       Plus the observability-plane invariants on a second, live
+       3-process fleet (``bench_fleet_obs``): labelled federated
+       worker series, idempotent re-scrape, a causally ordered
+       multi-lane merged trace, and a complete incident bundle —
+       with byte identity and zero recompiles intact under the plane.
     2. **Trajectory/local baseline** on the chunked fleet's mix
        tokens/s, calibrate-then-ratchet as the other gates.  (The
        chunked-TTFT win and the 0.9x tokens floor are pinned by the
@@ -974,6 +989,23 @@ def gate_fleet(threshold: float, backend: str, fp: str) -> dict:
             ok=False, decided_by="chaos_recovery",
             error=f"SIGKILL recovery failed: {chaos}",
         )
+        return out
+    # Fleet observability plane (hard): federation labels, idempotent
+    # re-scrape, a >= 2-lane causally ordered merged trace, and a
+    # complete incident bundle must hold on a LIVE fleet — with byte
+    # identity and zero recompiles intact under the plane — not just
+    # in the committed artifact.
+    obs = bench.bench_fleet_obs(n_requests=8, scrape_iters=5)
+    out["obs"] = {
+        k: obs.get(k) for k in (
+            "federated_labels_ok", "idempotent_rescrape",
+            "trace_lanes", "bundle_ok", "byte_identical",
+            "zero_recompiles",
+        )
+    }
+    if obs.get("error"):
+        out.update(ok=False, decided_by="observability_plane",
+                   error=f"fleet observability plane: {obs['error']}")
         return out
     committed = committed_fleet_reference()
     fleet_key = f"{backend}_serve_fleet"

@@ -76,7 +76,17 @@
 # respawning a real replacement process) backed by the fleet gate
 # (bench_gate.py gate_fleet: identity/zero-recompile/chunk-coverage/
 # chaos-recovery invariants hard, fleet tokens/s ratchet vs
-# docs/serving_fleet_cpu.json; --skip-fleet to skip), and a Pallas
+# docs/serving_fleet_cpu.json; --skip-fleet to skip), a fleet
+# observability-plane smoke leg (scripts/fleet_obs_smoke.py: a real
+# 3-process fleet under the router's metrics federation — every worker
+# series re-exported on the router /metrics with replica/role/
+# generation labels and idempotent re-scrape — plus one clock-aligned
+# merged Perfetto trace with a migrated request crossing process lanes
+# in causal order, and a SIGKILL-triggered incident bundle holding the
+# surviving replicas' flight dumps and the dead worker's stderr tail;
+# the fleet gate hard-pins the same invariants live via
+# bench.bench_fleet_obs and ratchets vs docs/fleet_obs_cpu.json), and
+# a Pallas
 # kernel-layer smoke leg (scripts/kernels_smoke.py: interpret-mode
 # bit parity for the paged-attention / fused-Adam / int8-matmul
 # kernels vs their lax references, real-Server byte identity gather
@@ -163,6 +173,10 @@ echo "# multi-process serving-fleet smoke leg"
 timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
 fleet_rc=$?
 [ $fleet_rc -ne 0 ] && echo "# fleet smoke FAILED (rc=$fleet_rc)"
+echo "# fleet observability-plane smoke leg"
+timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_obs_smoke.py
+fleet_obs_rc=$?
+[ $fleet_obs_rc -ne 0 ] && echo "# fleet obs smoke FAILED (rc=$fleet_obs_rc)"
 echo "# live-rollout (canary deploy + auto-rollback) smoke leg"
 timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/deploy_smoke.py
 deploy_rc=$?
@@ -212,6 +226,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$overload_rc
 [ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$fleet_rc
+[ $rc -eq 0 ] && rc=$fleet_obs_rc
 [ $rc -eq 0 ] && rc=$deploy_rc
 [ $rc -eq 0 ] && rc=$kernels_rc
 [ $rc -eq 0 ] && rc=$lint_rc

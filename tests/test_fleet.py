@@ -227,6 +227,13 @@ def test_changed_only_leg_mapping():
     assert bg.legs_for_changes(
         ["ml_trainer_tpu/resilience/faults.py"]
     ) == {"elastic", "overload", "fleet"}
+    # The observability spine rides the legs that read it — the SLO
+    # plane, the fleet gate (which pins the federation/trace/bundle
+    # invariants), and the rollout gate's SLO-burn rollback.
+    assert bg.legs_for_changes(
+        ["ml_trainer_tpu/telemetry/federation.py"]
+    ) == {"slo", "fleet", "deploy"}
+    assert bg.legs_for_changes(["docs/fleet_obs_cpu.json"]) == {"fleet"}
     # Unmapped file or unknown diff -> run everything (fail safe).
     assert bg.legs_for_changes(["setup.py"]) == set(bg.ALL_LEGS)
     assert bg.legs_for_changes(None) == set(bg.ALL_LEGS)

@@ -240,10 +240,11 @@ def test_health_payload_golden_shape(model_and_vars):
         payload = server.health()
     assert sorted(payload) == [
         "active_requests", "active_slots", "adapters_resident",
-        "adoptions_pending", "closed", "degradation_level", "draining",
-        "healthy", "kv_pages_free", "kv_pages_total", "max_slots", "ok",
-        "pid", "queue_depth", "queued_requests", "reason", "role",
-        "transport", "uptime_s", "weights_fp",
+        "adoptions_pending", "closed", "compile_events_post_warmup_total",
+        "degradation_level", "draining", "healthy", "kv_pages_free",
+        "kv_pages_total", "max_slots", "mono_epoch", "ok", "pid",
+        "queue_depth", "queued_requests", "reason", "role",
+        "trace_now_us", "transport", "uptime_s", "weights_fp",
     ]
     assert payload["ok"] is True and payload["role"] == "decode"
     # Deploys key KV portability on this: same-process servers sharing
