@@ -1858,6 +1858,226 @@ def bench_fleet_obs(n_requests=12, n_tenants=2, mean_interarrival=0.02,
     return result
 
 
+def bench_watchtower(sample_iters=200, eval_iters=200, render_iters=20,
+                     n_hosts=3, out_path=None):
+    """Watchtower overhead (telemetry/watchtower.py + alerts.py,
+    docs/observability.md "Watchtower"): what the TSDB + alert engine
+    + dashboard cost the host thread that already runs the publish
+    loops, plus the invariants that make the watching trustworthy.
+    One committed artifact (docs/watchtower_cpu.json):
+
+    * **overhead** — per-call wall-clock for one full registry sample
+      into the ring store (a serving-worker-sized registry: gauges,
+      counters, labeled histograms), one exposition ingest (the
+      router's federation path), one declarative alert-engine tick
+      (threshold + rate + burn + quantile + absent rules over every
+      label group), one windowed quantile query, and one dashboard
+      render.  All host-side, zero device work.
+    * **detection invariant** — an injected latency regression (the
+      TTFT histogram's observations jump 10x) must trip the
+      ``quantile_over_time`` rule on the FIRST evaluation after the
+      regressed samples land: detection latency is one sample tick +
+      one eval tick, never a window.
+    * **storage invariants** — rings stay bounded at their capacity
+      under sustained sampling, and a ``dump()`` -> ``load()``
+      round-trip is exact.
+
+    The ratcheted headline is ``sample_ops_per_sec`` (how many full
+    registry sweeps one core sustains) — the number that bounds what
+    the TSDB costs every publish cadence in the process.
+    """
+    from ml_trainer_tpu.telemetry.alerts import AlertEngine, AlertRule
+    from ml_trainer_tpu.telemetry.export import prometheus_text
+    from ml_trainer_tpu.telemetry.flight import FlightRecorder
+    from ml_trainer_tpu.telemetry.registry import MetricsRegistry
+    from ml_trainer_tpu.telemetry.watchtower import (
+        TimeSeriesStore, render_dashboard,
+    )
+
+    def _ms(samples):
+        if not samples:
+            return None
+        s = sorted(samples)
+        return {
+            "mean_ms": round(sum(s) / len(s) * 1e3, 3),
+            "p50_ms": round(s[len(s) // 2] * 1e3, 3),
+            "max_ms": round(s[-1] * 1e3, 3),
+            "n": len(s),
+        }
+
+    # A serving-worker-sized registry: the per-tenant latency
+    # histograms plus a spread of gauges/counters with host labels.
+    registry = MetricsRegistry()
+    rng = np.random.default_rng(0)
+    hists = [
+        registry.histogram(
+            f"serving_{which}_seconds", f"{which} latency",
+            labelnames=("tenant",),
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0),
+        )
+        for which in ("ttft", "tpot", "queue_wait", "e2e")
+    ]
+    for h in hists:
+        for tenant in ("alpha", "beta", "gamma"):
+            for v in rng.uniform(0.002, 0.04, 64):
+                h.labels(tenant=tenant).observe(float(v))
+    gauges = [
+        registry.gauge(f"watch_gauge_{i}", f"gauge {i}",
+                       labelnames=("host",))
+        for i in range(24)
+    ]
+    counters = [
+        registry.counter(f"watch_counter_{i}", f"counter {i}",
+                         labelnames=("host",))
+        for i in range(12)
+    ]
+    for h in range(n_hosts):
+        for g in gauges:
+            g.labels(host=str(h)).set(float(rng.uniform(0, 100)))
+        for c in counters:
+            c.labels(host=str(h)).inc(int(rng.integers(1, 50)))
+
+    result = {
+        "backend": jax.default_backend(),
+        "n_hosts": n_hosts,
+        "sample_iters": sample_iters,
+    }
+
+    # -- sampling overhead (the trainer/server publish-cadence cost) --
+    store = TimeSeriesStore(capacity=256)
+    sample_s = []
+    t = 0.0
+    for _ in range(sample_iters):
+        t += 1.0
+        t0 = time.perf_counter()
+        store.sample_registry(registry, t=t, force=True)
+        sample_s.append(time.perf_counter() - t0)
+    result["sample"] = _ms(sample_s)
+    result["series"] = len(store)
+    result["sample_ops_per_sec"] = round(
+        1.0 / max(sum(sample_s) / len(sample_s), 1e-9), 1
+    )
+
+    # -- ingest overhead (the router federation path) --
+    text = prometheus_text(registry)
+    ingest_store = TimeSeriesStore(capacity=256)
+    ingest_s = []
+    for i in range(max(sample_iters // 4, 1)):
+        t0 = time.perf_counter()
+        ingest_store.ingest_exposition(
+            text, t=float(i),
+            extra_labels={"replica": "w0", "role": "decode",
+                          "generation": "0"},
+            force=True,
+        )
+        ingest_s.append(time.perf_counter() - t0)
+    result["ingest"] = _ms(ingest_s)
+    result["exposition_bytes"] = len(text)
+
+    # -- alert-engine tick + windowed-query overhead --
+    flight = FlightRecorder()
+    engine = AlertEngine(
+        rules=[
+            AlertRule("gauge_high", "watch_gauge_0 > 1e9"),
+            AlertRule("counter_rate",
+                      "rate(watch_counter_0[32s]) > 1e9"),
+            AlertRule("burn_avg", "avg(watch_gauge_1[32s]) > 1e9",
+                      for_s=5.0),
+            AlertRule("ttft_q50",
+                      "quantile(0.5, serving_ttft_seconds{"
+                      'tenant=alpha}[32s]) > 0.2', for_count=1),
+            AlertRule("absent_series", "absent(no_such_series[32s])",
+                      severity="info"),
+        ],
+        store=store, registry=registry, flight=flight,
+    )
+    eval_s = []
+    for i in range(eval_iters):
+        t0 = time.perf_counter()
+        engine.evaluate(now=t)
+        eval_s.append(time.perf_counter() - t0)
+    result["alert_eval"] = _ms(eval_s)
+    query_s = []
+    for _ in range(eval_iters):
+        t0 = time.perf_counter()
+        store.quantile_over_time(
+            "serving_ttft_seconds", 0.5, labels={"tenant": "alpha"},
+            window_s=32.0, now=t,
+        )
+        query_s.append(time.perf_counter() - t0)
+    result["quantile_query"] = _ms(query_s)
+
+    # -- dashboard render --
+    render_s = []
+    html = ""
+    for _ in range(render_iters):
+        t0 = time.perf_counter()
+        html = render_dashboard(store, title="bench")
+        render_s.append(time.perf_counter() - t0)
+    result["dashboard_render"] = _ms(render_s)
+    result["dashboard_bytes"] = len(html)
+
+    # -- detection invariant: a 10x TTFT regression trips the
+    # quantile rule on the first eval after the regressed samples land.
+    assert not engine.rule("ttft_q50").firing()
+    for v in rng.uniform(0.3, 0.5, 48):  # the regression
+        hists[0].labels(tenant="alpha").observe(float(v))
+    t += 1.0
+    store.sample_registry(registry, t=t, force=True)
+    detect_t0 = time.perf_counter()
+    events = engine.evaluate(now=t)
+    detect_ms = (time.perf_counter() - detect_t0) * 1e3
+    fired = [
+        e for e in events
+        if e["rule"] == "ttft_q50" and e["state"] == "firing"
+    ]
+    result["detection"] = {
+        "fired_first_eval": bool(fired),
+        "eval_ms": round(detect_ms, 3),
+        "quantile_seen": fired[0]["value"] if fired else None,
+        "flight_alerts": sum(
+            1 for r in flight.records() if r.get("kind") == "alert"
+        ),
+    }
+
+    # -- storage invariants --
+    bounded = all(
+        len(points) <= 256
+        for _, points in store.select("serving_ttft_seconds_bucket", {})
+    ) and len(store.last("watch_gauge_0", {"host": "0"}, n=10 ** 6)) <= 256
+    dump = store.dump()
+    roundtrip = TimeSeriesStore.load(dump).dump() == dump
+    result["ring_bounded"] = bool(bounded)
+    result["dump_roundtrip_exact"] = bool(roundtrip)
+
+    if not result["detection"]["fired_first_eval"]:
+        result["error"] = (
+            "injected TTFT regression did not fire the quantile rule "
+            "on the first evaluation"
+        )
+    elif not result["ring_bounded"]:
+        result["error"] = "ring exceeded its capacity under sampling"
+    elif not result["dump_roundtrip_exact"]:
+        result["error"] = "dump -> load round-trip not exact"
+    print(
+        "# watchtower: sample "
+        f"{(result.get('sample') or {}).get('mean_ms')} ms "
+        f"({result['series']} series, "
+        f"{result['sample_ops_per_sec']} sweeps/s), ingest "
+        f"{(result.get('ingest') or {}).get('mean_ms')} ms, eval "
+        f"{(result.get('alert_eval') or {}).get('mean_ms')} ms, render "
+        f"{(result.get('dashboard_render') or {}).get('mean_ms')} ms"
+        + ("" if not result.get("error") else
+           f"  [FAILED: {result['error']}]"),
+        flush=True,
+    )
+    if out_path:
+        with open(out_path, "w", encoding="utf-8") as fp:
+            json.dump(result, fp, indent=1)
+        print(f"# watchtower artifact -> {out_path}", flush=True)
+    return result
+
+
 def bench_serve_deploy(n_requests=24, n_tenants=8, mean_interarrival=0.12,
                        page_size=8, max_batch=4, seed=0,
                        ttft_ms=2000.0, tpot_ms=2000.0, wedge_s=3.0,
@@ -4107,6 +4327,15 @@ def main():
                         "lanes, complete bundle, byte identity, zero "
                         "recompiles); writes docs/fleet_obs_cpu.json "
                         "(gpt2_tiny; CPU-safe)")
+    parser.add_argument("--watchtower", action="store_true",
+                        help="run only the watchtower bench: the "
+                        "in-process TSDB + alert engine + dashboard "
+                        "measured on a serving-worker-sized registry "
+                        "(sample/ingest/eval/query/render per-call ms) "
+                        "with the one-eval-window regression-detection, "
+                        "ring-bound and dump-roundtrip invariants "
+                        "pinned; writes docs/watchtower_cpu.json "
+                        "(pure host; CPU-safe)")
     parser.add_argument("--serve-deploy", action="store_true",
                         help="run only the live-rollout bench: train a "
                         "tiny gpt2 in-bench, export it, and deploy the "
@@ -4338,6 +4567,21 @@ def main():
         )
         result = bench_fleet_obs(out_path=out)
         print(json.dumps({"fleet_obs": result}))
+        if result.get("error"):
+            sys.exit(1)
+        return
+    if args.watchtower:
+        # Watchtower TSDB + alert engine + dashboard overhead; the
+        # artifact is the acceptance evidence for the fourth
+        # observability pillar and feeds bench_gate.py gate_watchtower.
+        import os as _os
+
+        out = _os.path.join(
+            _os.path.dirname(_os.path.abspath(__file__)),
+            "docs", "watchtower_cpu.json",
+        )
+        result = bench_watchtower(out_path=out)
+        print(json.dumps({"watchtower": result}))
         if result.get("error"):
             sys.exit(1)
         return

@@ -292,6 +292,19 @@ class Server:
         self.engine._flight.register_context_provider(
             "serving_requests", self.slo.context_payload
         )
+        # Watchtower TSDB (telemetry/watchtower.py): history behind this
+        # process's registry, sampled on every /metrics publish — the
+        # router's federation scrape doubles as the sampler — and served
+        # as sparklines on GET /dash.  Pure host work: no device calls,
+        # no compiled programs.
+        from ml_trainer_tpu.telemetry.watchtower import (
+            TimeSeriesStore, watch_context,
+        )
+
+        self.watchtower = TimeSeriesStore()
+        self.engine._flight.register_context_provider(
+            "watchtower", lambda: watch_context(self.watchtower)
+        )
         self._idle_poll = idle_poll
         self._log = get_logger("ml_trainer_tpu.serving")
         self._wake = threading.Event()
@@ -1446,6 +1459,20 @@ class Server:
                             str(body.get("reason", "failed by admin"))
                         )
                         self._send(200, {"ok": True})
+                    elif path == "/admin/faults":
+                        # Arm a chaos plan in THIS process after spawn
+                        # (resilience/faults.py spec syntax) — how the
+                        # watchtower smoke injects replica_slow into a
+                        # fleet worker once warmup is done.  An empty
+                        # spec uninstalls.
+                        from ml_trainer_tpu.resilience import faults
+
+                        spec = str(body.get("spec", ""))
+                        if spec:
+                            faults.install(faults.FaultPlan.parse(spec))
+                        else:
+                            faults.uninstall()
+                        self._send(200, {"ok": True, "spec": spec})
                     elif path == "/admin/evacuate":
                         # Stream-sink evacuation: each active slot's
                         # export rides its OWN open stream as an "m"
@@ -1520,6 +1547,10 @@ class Server:
                     registry = default_registry()
                     server.metrics.publish(registry)
                     server.slo.publish(registry)
+                    # Watchtower sampling rides the publish cadence: the
+                    # scrape that reads the gauges also appends them to
+                    # the history rings behind /dash.
+                    server.watchtower.sample_registry(registry)
                     self._send_text(
                         200, registry.prometheus_text(),
                         "text/plain; version=0.0.4; charset=utf-8",
@@ -1545,6 +1576,22 @@ class Server:
                     # attainment + burn rate) — the JSON twin of the
                     # serving_slo_* series on /metrics.
                     self._send(200, server.slo.snapshot())
+                elif self.path == "/dash":
+                    # Watchtower live dashboard: the process's sampled
+                    # series as self-contained HTML stat tiles +
+                    # sparklines (stdlib only, no external assets).
+                    from ml_trainer_tpu.telemetry.watchtower import (
+                        render_dashboard,
+                    )
+
+                    self._send_text(
+                        200,
+                        render_dashboard(
+                            server.watchtower,
+                            title=server.name or server.role,
+                        ),
+                        "text/html; charset=utf-8",
+                    )
                 else:
                     self._send(404, {"error": "not found"})
 

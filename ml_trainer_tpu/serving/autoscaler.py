@@ -51,6 +51,7 @@ import time
 from typing import Callable, List, Optional
 
 from ml_trainer_tpu.serving.slo import aggregate_timelines
+from ml_trainer_tpu.telemetry.alerts import AlertEngine, AlertRule
 from ml_trainer_tpu.utils.logging import get_logger
 
 
@@ -112,12 +113,38 @@ class Autoscaler:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self._high_streak = 0
-        self._low_streak = 0
         self._last_action_at = -10.0 ** 9
         self._auto_seq = 0
         self.actions: List[dict] = []
         self.last_burn: Optional[float] = None
+        # The hysteresis streaks, re-expressed as for_count alert rules
+        # on the fleet's AlertEngine (ONE alerting path): the high/low
+        # rules carry the consecutive-poll state the loop used to keep
+        # by hand, firing = streak reached, and the post-action streak
+        # reset is rule.reset().  Cooldown gating stays OUT here — a
+        # rule keeps firing through a cooldown, exactly as the streak
+        # kept growing.
+        engine = getattr(router, "alerts", None)
+        if engine is None:
+            engine = AlertEngine(clock=self._clock)
+        self.alerts = engine
+        cfg = self.config
+        self._rule_high = engine.add_rule(AlertRule(
+            "autoscaler_burn_high", for_count=cfg.high_polls,
+            severity="warn",
+            description=(
+                f"windowed TTFT burn >= {cfg.burn_high} for "
+                f"{cfg.high_polls} consecutive polls"
+            ),
+        ))
+        self._rule_low = engine.add_rule(AlertRule(
+            "autoscaler_burn_low", for_count=cfg.low_polls,
+            severity="info",
+            description=(
+                f"windowed TTFT burn <= {cfg.burn_low} for "
+                f"{cfg.low_polls} consecutive polls (recovery)"
+            ),
+        ))
 
     # -- lifecycle --------------------------------------------------------
 
@@ -361,26 +388,39 @@ class Autoscaler:
 
         burn = fleet["burn"]
         if burn is None:
-            return None
+            return None  # too few requests: rules hold, nothing observed
+        extra = {"window_requests": fleet["window_requests"]}
+        high_firing = low_firing = False
         if burn >= cfg.burn_high:
-            self._high_streak += 1
-            self._low_streak = 0
+            high_firing = self.alerts.observe(
+                "autoscaler_burn_high", True, now=now, value=burn,
+                extra=extra,
+            )
+            self.alerts.observe(
+                "autoscaler_burn_low", False, now=now, value=burn,
+            )
         elif burn <= cfg.burn_low:
-            self._low_streak += 1
-            self._high_streak = 0
+            self.alerts.observe(
+                "autoscaler_burn_high", False, now=now, value=burn,
+            )
+            low_firing = self.alerts.observe(
+                "autoscaler_burn_low", True, now=now, value=burn,
+                extra=extra,
+            )
         else:
             # Inside the hysteresis band: streaks decay, nothing acts.
-            self._high_streak = 0
-            self._low_streak = 0
+            self.alerts.observe(
+                "autoscaler_burn_high", False, now=now, value=burn,
+            )
+            self.alerts.observe(
+                "autoscaler_burn_low", False, now=now, value=burn,
+            )
             return None
 
         cause = (
             f"ttft burn {burn} over {fleet['window_requests']} request(s)"
         )
-        if (
-            self._high_streak >= cfg.high_polls
-            and self._cooldown_ok(now)
-        ):
+        if high_firing and self._cooldown_ok(now):
             if fleet["total"] < cfg.max_replicas:
                 role = "both"
                 if self.router.mode == "disagg":
@@ -390,10 +430,10 @@ class Autoscaler:
                         >= fleet["decode_pressure"] else "decode"
                     )
                 if self._scale_up(role, cause, now):
-                    self._high_streak = 0
+                    self._rule_high.reset()
                     return "scale_up"
             if self._maybe_flip_role(fleet, cause, now):
-                self._high_streak = 0
+                self._rule_high.reset()
                 return "reassign_role"
             # No capacity to add: brownout beats blackout.
             if self.ladder.level < 4:
@@ -403,13 +443,10 @@ class Autoscaler:
                     "degrade", cause, level=self.ladder.level,
                     rung=self.ladder.rung,
                 )
-                self._high_streak = 0
+                self._rule_high.reset()
                 return "degrade"
             return None
-        if (
-            self._low_streak >= cfg.low_polls
-            and self._cooldown_ok(now)
-        ):
+        if low_firing and self._cooldown_ok(now):
             recovery = f"ttft burn {burn} (recovered)"
             if self.ladder.level > 0:
                 self._last_action_at = now
@@ -418,10 +455,10 @@ class Autoscaler:
                     "undegrade", recovery, level=self.ladder.level,
                     rung=self.ladder.rung,
                 )
-                self._low_streak = 0
+                self._rule_low.reset()
                 return "undegrade"
             if cfg.scale_down and self._scale_down(fleet, recovery, now):
-                self._low_streak = 0
+                self._rule_low.reset()
                 return "scale_down"
         return None
 

@@ -53,6 +53,7 @@ import numpy as np
 
 from ml_trainer_tpu.serving.scheduler import Request
 from ml_trainer_tpu.serving.slo import aggregate_timelines
+from ml_trainer_tpu.telemetry.alerts import AlertEngine, AlertRule
 from ml_trainer_tpu.utils.logging import get_logger
 
 # Terminal states: the deployment thread exits, Router.deploy() will
@@ -160,7 +161,6 @@ class Deployment:
 
         self._stage_idx = -1               # index into config.fractions()
         self._stage_clean_since: Optional[float] = None
-        self._high_streak = 0
         self._split_since: Optional[float] = None  # time.monotonic stamp
         self._started_at = self._clock()
 
@@ -170,6 +170,24 @@ class Deployment:
         self._shadow_rows: List[dict] = []
         self._shadow_since: Optional[float] = None
         self._installed_tap: Optional[Callable] = None
+
+        # The canary burn watch, re-expressed as a for_count alert rule
+        # on the fleet's AlertEngine (ONE alerting path): the rule keeps
+        # the consecutive-high-poll streak, firing = rollback.  The rule
+        # name carries the generation so back-to-back deployments over
+        # one router never share state.
+        engine = getattr(router, "alerts", None)
+        if engine is None:
+            engine = AlertEngine(clock=self._clock)
+        self.alerts = engine
+        self._burn_rule = engine.add_rule(AlertRule(
+            f"deploy_canary_burn_gen{self.generation}",
+            for_count=self.config.high_polls, severity="warn",
+            description=(
+                f"canary slice SLO burn >= {self.config.burn_threshold} "
+                f"for {self.config.high_polls} consecutive polls"
+            ),
+        ))
 
     # -- lifecycle --------------------------------------------------------
 
@@ -520,7 +538,7 @@ class Deployment:
         if self._split_since is None:
             self._split_since = time.monotonic()
         self._stage_clean_since = self._clock()
-        self._high_streak = 0
+        self._burn_rule.reset()
         self._record("stage", fraction=fraction, stage=idx, plan=plan)
         self._transition("canary" if idx == 0 else "ramping",
                          fraction=fraction)
@@ -553,22 +571,27 @@ class Deployment:
         if agg is not None:
             burn = max(agg["burn_rate"]["ttft"], agg["burn_rate"]["tpot"])
             self.last_burn = burn
-            if burn >= self.config.burn_threshold:
-                self._high_streak += 1
+            high = burn >= self.config.burn_threshold
+            firing = self.alerts.observe(
+                self._burn_rule.name, high, now=now, value=burn,
+                extra={"window_requests": agg["n_requests"],
+                       "generation": self.generation},
+            )
+            if high:
+                streak = self._burn_rule.count()
                 self._stage_clean_since = now
                 self._record(
-                    "burn_high", burn=burn, streak=self._high_streak,
+                    "burn_high", burn=burn, streak=streak,
                     window_requests=agg["n_requests"],
                 )
-                if self._high_streak >= self.config.high_polls:
+                if firing:
                     self._rollback(
                         f"canary burn {burn:.2f} >= "
                         f"{self.config.burn_threshold} for "
-                        f"{self._high_streak} polls "
+                        f"{streak} polls "
                         f"({agg['n_requests']} requests in window)"
                     )
                 return
-            self._high_streak = 0
         if now - self._stage_clean_since < self.config.hold_s:
             return
         if self.config.stage_min_requests and (

@@ -95,7 +95,12 @@ from ml_trainer_tpu.serving.scheduler import (
 from ml_trainer_tpu.serving.slo import SloPolicy, SloTracker
 from ml_trainer_tpu.serving.transfer import MigrationCorrupt
 from ml_trainer_tpu.telemetry import federation, spans
+from ml_trainer_tpu.telemetry.alerts import AlertEngine, AlertRule
 from ml_trainer_tpu.telemetry.flight import get_recorder
+from ml_trainer_tpu.telemetry.watchtower import (
+    TimeSeriesStore,
+    render_dashboard,
+)
 from ml_trainer_tpu.utils.logging import get_logger
 
 # Stream sentinel kind the migration sink pushes between tokens: the
@@ -427,7 +432,8 @@ class Router:
                  degradation: Optional[DegradationConfig] = None,
                  metrics_scrape_interval: float = 1.0,
                  incident_dir: Optional[str] = None,
-                 incident_min_interval_s: float = 30.0):
+                 incident_min_interval_s: float = 30.0,
+                 alert_rules: Optional[Sequence[AlertRule]] = None):
         """Hardening knobs (docs/serving.md "Surviving overload"):
 
         ``unhealthy_after``: consecutive FAILED health polls before a
@@ -537,6 +543,19 @@ class Router:
         self._incident_lock = threading.Lock()
         self._last_incident_at = 0.0
         self.last_incident_path: Optional[str] = None
+        # Watchtower (telemetry/watchtower.py + alerts.py): the fleet
+        # TSDB — every scraped worker exposition lands here with its
+        # federation labels, beside the router's own registry sweep —
+        # and the declarative alert engine evaluated on each poll tick.
+        # Severity-`page` rules fire straight into trigger_incident, so
+        # a rule firing assembles the same bundle a replica death does.
+        self.watchtower = TimeSeriesStore()
+        self.alerts = AlertEngine(
+            alert_rules or (), store=self.watchtower,
+            incident_trigger=self.trigger_incident,
+        )
+        self._wt_ingested: Dict[str, float] = {}
+        self._wt_sampled_at = 0.0
         self._reindex_replicas()
         self._rebuild_ring()
         self._busy_polls = 0
@@ -1734,6 +1753,7 @@ class Router:
                 rep.healthy = ok
                 self.metrics.set_replica_health(rep.name, ok)
             self.scrape_metrics()
+            self._watchtower_tick()
             self._stop_event.wait(self._health_interval)
 
     def _fire_chaos_kill(self) -> None:
@@ -1764,6 +1784,52 @@ class Router:
                 return
 
     # -- telemetry --------------------------------------------------------
+
+    def _watchtower_tick(self) -> None:
+        """One TSDB + alert sweep, riding the health poll: ingest every
+        FRESH worker exposition (federation labels preserved), sample
+        the router's own registry at the scrape cadence, then evaluate
+        the declarative rules.  Best-effort — the poller never dies on
+        observability work."""
+        try:
+            now = time.time()
+            mono = time.monotonic()
+            for name, rep in self._replicas.items():
+                if rep.metrics_text is None:
+                    continue
+                # Only ingest a snapshot once: scrape pacing stamps
+                # metrics_scraped_at, so an unchanged stamp means the
+                # same bytes (replace, never re-append).
+                if self._wt_ingested.get(name) == rep.metrics_scraped_at:
+                    continue
+                self._wt_ingested[name] = rep.metrics_scraped_at
+                self.watchtower.ingest_exposition(
+                    rep.metrics_text, t=now,
+                    extra_labels={
+                        "replica": name, "role": rep.role,
+                        "generation": str(rep.generation),
+                    },
+                    force=True,
+                )
+            if mono - self._wt_sampled_at >= self.metrics_scrape_interval:
+                self._wt_sampled_at = mono
+                from ml_trainer_tpu.telemetry.registry import (
+                    default_registry,
+                )
+
+                registry = default_registry()
+                self.publish(registry)
+                self.watchtower.sample_registry(
+                    registry, t=now, force=True
+                )
+            self.alerts.evaluate(now=now)
+        except Exception as e:  # noqa: BLE001 — poller survives anything
+            self._log.info("router_watchtower_tick_failed", error=str(e))
+
+    def add_alert_rule(self, rule: AlertRule) -> AlertRule:
+        """Install one more declarative rule on the fleet engine (takes
+        effect on the next poll tick)."""
+        return self.alerts.add_rule(rule)
 
     def publish(self, registry=None) -> dict:
         """Mirror the router counters into the telemetry registry (and
@@ -2070,6 +2136,13 @@ class Router:
         _write("slo_timelines.json", self.slo.timelines())
         _write("metrics.prom", self.federated_metrics_text())
         _write("router.json", self.snapshot())
+        # Watchtower: the dashboard at capture time (the trend INTO the
+        # incident, not just the instant) plus the full alert history.
+        _write("dashboard.html", render_dashboard(
+            self.watchtower, title=f"incident: {reason}",
+            alerts=self.alerts.history(),
+        ))
+        _write("alerts.json", self.alerts.payload())
         for name in dead:
             rep = self._replicas.get(name)
             tail_fn = getattr(
@@ -2161,6 +2234,21 @@ class Router:
                     self._send(200, router.fleet_trace())
                 elif self.path == "/slo":
                     self._send(200, router.slo.snapshot())
+                elif self.path == "/dash":
+                    # Fleet-wide live dashboard: the router's TSDB holds
+                    # every replica's series (replica=/role= labels) so
+                    # one page shows the whole fleet's trends.
+                    body = render_dashboard(
+                        router.watchtower, title="router",
+                        alerts=router.alerts.history(),
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/html; charset=utf-8"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._send(404, {"error": "not found"})
 

@@ -36,6 +36,14 @@ Four parts (docs/observability.md):
   JAX's own compilation path — ``compile_events_total{fn=}``, flight
   ``recompile`` events naming the offending shape
   (``compile_watch.py``).
+* **watchtower** — the in-process time-series store: bounded per-series
+  rings sampled from the registry at the existing publish cadences,
+  windowed ``rate()`` / ``quantile_over_time()`` queries, declarative
+  :class:`~.alerts.AlertRule` evaluation (threshold / rate-of-change /
+  burn / absent-series; the autoscaler, deploy-canary and straggler
+  watchers are rules on this engine), a stdlib-only live HTML dashboard
+  (``GET /dash``), and snapshots into incident bundles and the run
+  report (``watchtower.py`` / ``alerts.py``).
 """
 
 from ml_trainer_tpu.telemetry.cluster import (
@@ -43,7 +51,16 @@ from ml_trainer_tpu.telemetry.cluster import (
     ClusterTelemetry,
     write_run_report,
 )
-from ml_trainer_tpu.telemetry.export import JsonlSink, prometheus_text
+from ml_trainer_tpu.telemetry.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_fleet_rules,
+)
+from ml_trainer_tpu.telemetry.export import (
+    JsonlSink,
+    prometheus_text,
+    read_sink_records,
+)
 from ml_trainer_tpu.telemetry.flight import (
     FLIGHT_DIR_ENV,
     FlightRecorder,
@@ -77,6 +94,15 @@ from ml_trainer_tpu.telemetry.spans import (
     trace_events,
 )
 from ml_trainer_tpu.telemetry.train_metrics import TrainTelemetry
+from ml_trainer_tpu.telemetry.watchtower import (
+    TimeSeriesStore,
+    default_store,
+    install_flight_context,
+    render_dashboard,
+    reset_default_store,
+    save_dashboard,
+    watch_context,
+)
 
 __all__ = [
     "Counter",
@@ -109,4 +135,15 @@ __all__ = [
     "ClusterTelemetry",
     "HEARTBEAT_FIELDS",
     "write_run_report",
+    "read_sink_records",
+    "TimeSeriesStore",
+    "default_store",
+    "reset_default_store",
+    "watch_context",
+    "install_flight_context",
+    "render_dashboard",
+    "save_dashboard",
+    "AlertRule",
+    "AlertEngine",
+    "default_fleet_rules",
 ]

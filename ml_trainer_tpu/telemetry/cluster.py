@@ -43,6 +43,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ml_trainer_tpu.telemetry import flight as _flight
+from ml_trainer_tpu.telemetry.alerts import AlertEngine, AlertRule
 from ml_trainer_tpu.telemetry.registry import default_registry
 from ml_trainer_tpu.utils.logging import get_logger
 
@@ -123,6 +124,21 @@ class ClusterTelemetry:
             "straggler_factor x the cluster-median step time",
             ("host",),
         )
+        # The straggler verdict, re-expressed as an event-mode alert
+        # rule (ONE alerting path): every true evaluation fires — no
+        # latched state, the legacy re-fire-per-round behavior — and the
+        # legacy side effects (counter, flight `straggler` forensics,
+        # warning log, on_straggler hook) ride along as the rule's
+        # action.
+        self.alerts = AlertEngine(registry=self.registry, flight=self.flight)
+        self._straggler_rule = self.alerts.add_rule(AlertRule(
+            "cluster_straggler", mode="event", severity="warn",
+            actions=(self._straggler_fired,),
+            description=(
+                f"host step-ms p50 above {self.straggler_factor:g}x the "
+                "cluster lower-median"
+            ),
+        ))
 
     # -- host-local -----------------------------------------------------
     def heartbeat(self, **fields) -> None:
@@ -189,25 +205,46 @@ class ClusterTelemetry:
         if median <= 0:
             return
         for h, t in enumerate(times):
-            if t > self.straggler_factor * median:
-                self.c_straggler.labels(host=h).inc()
-                self.flight.record(
-                    "straggler",
-                    host=int(h),
-                    step=int(step) if step is not None else None,
-                    step_ms_p50=round(float(t), 3),
-                    cluster_median_ms=round(median, 3),
-                    factor=round(float(t) / median, 2),
-                )
-                logger.warning(
-                    f"straggler: host {h} step p50 {t:.1f}ms vs cluster "
-                    f"median {median:.1f}ms "
-                    f"(>{self.straggler_factor:g}x, step {step})"
-                )
-                if self.on_straggler is not None:
-                    self.on_straggler(
-                        host=int(h), factor=float(t) / median, step=step
-                    )
+            self.alerts.observe(
+                "cluster_straggler",
+                float(t) > self.straggler_factor * median,
+                value=float(t),
+                labels={"host": str(int(h))},
+                extra={
+                    "host": int(h),
+                    "step": int(step) if step is not None else None,
+                    "step_ms_p50": round(float(t), 3),
+                    "cluster_median_ms": round(median, 3),
+                    "factor": round(float(t) / median, 2),
+                    # Unrounded, for the on_straggler verdict hook (the
+                    # elastic controller thresholds on it).
+                    "factor_raw": float(t) / median,
+                },
+            )
+
+    def _straggler_fired(self, ev: dict) -> None:
+        """The rule's action: the legacy straggler side effects, fed the
+        emitted alert event (which carries the detection forensics as
+        ``extra`` fields)."""
+        h = int(ev["host"])
+        self.c_straggler.labels(host=h).inc()
+        self.flight.record(
+            "straggler",
+            host=h,
+            step=ev["step"],
+            step_ms_p50=ev["step_ms_p50"],
+            cluster_median_ms=ev["cluster_median_ms"],
+            factor=ev["factor"],
+        )
+        logger.warning(
+            f"straggler: host {h} step p50 {ev['step_ms_p50']:.1f}ms vs "
+            f"cluster median {ev['cluster_median_ms']:.1f}ms "
+            f"(>{self.straggler_factor:g}x, step {ev['step']})"
+        )
+        if self.on_straggler is not None:
+            self.on_straggler(
+                host=h, factor=float(ev["factor_raw"]), step=ev["step"],
+            )
 
     def cluster_view(self) -> Dict[str, Dict[str, float]]:
         """The last published cluster state, host -> field -> value (from
@@ -514,6 +551,19 @@ def write_run_report(out_dir: str, *, history: Optional[dict] = None,
             fp.write(_markdown_report(report))
         os.replace(tmp, md_path)
         report["paths"] = {"json": json_path, "md": md_path}
+        # Watchtower snapshot: when the process-wide store holds history
+        # (the trainer sampled at its sync cadence), the report gains
+        # the dashboard the metrics LOOKED like over the run — numbers
+        # age out of gauges, the rings keep the trend.
+        from ml_trainer_tpu.telemetry.watchtower import (
+            default_store, save_dashboard,
+        )
+
+        store = default_store()
+        if len(store):
+            dash_path = os.path.join(out_dir, "dashboard.html")
+            save_dashboard(store, dash_path, title=f"run report: {reason}")
+            report["paths"]["dashboard"] = dash_path
         logger.info(f"run report written: {json_path}")
     except OSError as e:
         logger.error(f"run report write failed ({json_path}): {e}")

@@ -77,19 +77,65 @@ class JsonlSink:
     ``write(record)`` appends one timestamped JSON line;
     ``write_registry(registry)`` appends the registry's flat snapshot.
     The file handle is opened lazily and each line is flushed, so a
-    crashed process keeps every record written before the crash."""
+    crashed process keeps every record written before the crash.
 
-    def __init__(self, path: str):
+    ``max_bytes`` (None = unbounded, the historical behavior) bounds
+    the LIVE file: a write that would cross the bound first rotates the
+    live file to ``<base>.<seq><ext>`` and records the segment in the
+    sidecar index (``<path>.index.json``), so a long fleet run stops
+    growing one unbounded file per worker and :func:`read_sink_records`
+    can replay every segment in order."""
+
+    def __init__(self, path: str, max_bytes: Optional[int] = None):
         self.path = path
+        self.max_bytes = int(max_bytes) if max_bytes else None
         self._lock = threading.Lock()
         self._fp = None
+        self._bytes = 0
+        self._seq = 0
+
+    @property
+    def index_path(self) -> str:
+        return self.path + ".index.json"
 
     def _handle(self):
         if self._fp is None:
             d = os.path.dirname(os.path.abspath(self.path))
             os.makedirs(d, exist_ok=True)
             self._fp = open(self.path, "a", encoding="utf-8")
+            self._bytes = self._fp.tell()
+            # Resume the segment counter past any prior rotation (a
+            # re-opened sink must not overwrite rotated segments).
+            idx = self._read_index()
+            self._seq = len(idx.get("rotated", []))
         return self._fp
+
+    def _read_index(self) -> dict:
+        try:
+            with open(self.index_path, encoding="utf-8") as fp:
+                return json.load(fp)
+        except (OSError, json.JSONDecodeError):
+            return {"version": 1, "live": self.path, "rotated": []}
+
+    def _rotate_locked(self) -> None:
+        self._fp.close()
+        self._fp = None
+        self._seq += 1
+        base, ext = os.path.splitext(self.path)
+        rotated = f"{base}.{self._seq:04d}{ext or '.jsonl'}"
+        os.replace(self.path, rotated)
+        idx = self._read_index()
+        idx["live"] = self.path
+        idx.setdefault("rotated", []).append({
+            "path": rotated,
+            "bytes": self._bytes,
+            "rotated_at": round(time.time(), 6),
+        })
+        tmp = self.index_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fp:
+            json.dump(idx, fp)
+        os.replace(tmp, self.index_path)
+        self._bytes = 0
 
     def write(self, record: dict, kind: str = "event") -> None:
         row = {"ts": round(time.time(), 6), "kind": kind}
@@ -97,8 +143,16 @@ class JsonlSink:
         line = json.dumps(row, default=str)
         with self._lock:
             fp = self._handle()
+            if (
+                self.max_bytes is not None
+                and self._bytes > 0
+                and self._bytes + len(line) + 1 > self.max_bytes
+            ):
+                self._rotate_locked()
+                fp = self._handle()
             fp.write(line + "\n")
             fp.flush()
+            self._bytes += len(line) + 1
 
     def write_registry(self, registry) -> None:
         self.write(registry.snapshot(), kind="metrics")
@@ -110,10 +164,44 @@ class JsonlSink:
                 self._fp = None
 
 
+def read_sink_records(path: str) -> list:
+    """Every record a (possibly rotated) sink wrote, oldest first: the
+    index's rotated segments in rotation order, then the live file.
+    Tolerates a missing index (unrotated sink) and a truncated final
+    line (crash mid-write)."""
+    paths = []
+    try:
+        with open(path + ".index.json", encoding="utf-8") as fp:
+            idx = json.load(fp)
+        paths.extend(seg["path"] for seg in idx.get("rotated", []))
+    except (OSError, json.JSONDecodeError):
+        pass
+    paths.append(path)
+    out = []
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as fp:
+                for line in fp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+        except OSError:
+            continue
+    return out
+
+
 _default_sink: Optional[JsonlSink] = None
 _sink_lock = threading.Lock()
 
 SINK_ENV = "ML_TRAINER_TPU_METRICS_JSONL"
+# Size bound (MB, float) for the live JSONL file; unset/0 = unbounded
+# (the historical default).  Crossing the bound rotates the live file
+# to `<base>.<seq><ext>` and records it in `<path>.index.json`.
+SINK_MAX_MB_ENV = "ML_TRAINER_TPU_METRICS_JSONL_MAX_MB"
 # Set by the fleet launcher (serving/fleet.py spawn): each worker
 # process inherits the driver's SINK_ENV path, and N workers appending
 # to ONE file interleave lines mid-record.  The worker id (or, for any
@@ -140,6 +228,11 @@ def default_sink() -> Optional[JsonlSink]:
     global _default_sink
     path = os.environ.get(SINK_ENV, "")
     worker = os.environ.get(SINK_WORKER_ENV, "")
+    try:
+        max_mb = float(os.environ.get(SINK_MAX_MB_ENV, "") or 0.0)
+    except ValueError:
+        max_mb = 0.0
+    max_bytes = int(max_mb * 1024 * 1024) if max_mb > 0 else None
     with _sink_lock:
         if not path:
             return None
@@ -148,5 +241,5 @@ def default_sink() -> Optional[JsonlSink]:
                 path, worker if worker != "pid" else str(os.getpid())
             )
         if _default_sink is None or _default_sink.path != path:
-            _default_sink = JsonlSink(path)
+            _default_sink = JsonlSink(path, max_bytes=max_bytes)
         return _default_sink

@@ -190,6 +190,15 @@ class TrainTelemetry:
         from ml_trainer_tpu.telemetry.goodput import GoodputMeter
 
         self.goodput = GoodputMeter(registry=r)
+        # Watchtower flight context: a crash dump carries the last-N
+        # samples of the headline series (goodput, SLO burn, KV pages,
+        # post-warmup compiles) — the trend INTO the crash, not just the
+        # final values.  Idempotent by provider name.
+        from ml_trainer_tpu.telemetry.watchtower import (
+            install_flight_context,
+        )
+
+        install_flight_context(recorder=self.flight)
         # The per-schedule train_pipeline_bubble_fraction{schedule=}
         # gauge is owned by parallel/pipeline.py (set at trace time, the
         # comm_stats discipline); on_sync only folds the active
@@ -325,6 +334,12 @@ class TrainTelemetry:
         sink = default_sink()
         if sink is not None:
             sink.write(event, kind="train_step")
+        # Watchtower: the sync point IS the trainer's sample cadence —
+        # every registry instrument gains history in the process-wide
+        # TSDB (bounded rings, host-only, zero device work).
+        from ml_trainer_tpu.telemetry.watchtower import default_store
+
+        default_store().sample_registry(self.registry)
         if self.cluster is not None:
             # Host-local heartbeat refresh; the cross-host allgather stays
             # at the Trainer's epoch boundary (collective discipline).

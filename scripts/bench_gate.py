@@ -113,7 +113,7 @@ BASELINE_FILE = os.path.join(REPO, ".bench_gate_baseline.json")
 ALL_LEGS = frozenset({
     "parity", "serve", "mixed", "pipeline", "slo", "disagg", "lora",
     "overload", "goodput", "elastic", "lint", "fleet", "kernels",
-    "deploy",
+    "deploy", "watchtower",
 })
 
 # Committed artifacts map to exactly the leg that ratchets against
@@ -133,6 +133,7 @@ _ARTIFACT_LEGS = {
     "elastic_chaos_cpu.json": "elastic",
     "graft_lint_baseline.json": "lint",
     "kernels_cpu.json": "kernels",
+    "watchtower_cpu.json": "watchtower",
 }
 
 
@@ -198,12 +199,14 @@ def legs_for_changes(files) -> set:
             continue
         if path.startswith("ml_trainer_tpu/telemetry/"):
             # The observability spine (registry/spans/flight/export/
-            # federation) is exercised end-to-end by the legs that
-            # read it: the SLO plane, the multi-process fleet (whose
-            # gate pins the federation/trace/bundle invariants), and
-            # the rollout gate's SLO-burn rollback.  A telemetry edit
-            # cannot move a train-step or kernel number.
-            legs.update({"slo", "fleet", "deploy"})
+            # federation/watchtower) is exercised end-to-end by the
+            # legs that read it: the SLO plane, the multi-process fleet
+            # (whose gate pins the federation/trace/bundle invariants),
+            # the rollout gate's SLO-burn rollback, and the watchtower
+            # gate (TSDB/alert-engine/dashboard overhead + detection
+            # invariant).  A telemetry edit cannot move a train-step or
+            # kernel number.
+            legs.update({"slo", "fleet", "deploy", "watchtower"})
             continue
         if base == "graft_lint.py" and path.startswith("scripts/"):
             legs.add("lint")
@@ -690,6 +693,103 @@ def gate_slo(threshold: float, backend: str, fp: str) -> dict:
             f"{top_rate} rps is >{threshold * 100:.0f}% below this "
             f"machine's baseline {baseline}"
         )
+    return out
+
+
+def perf_attribution(committed_path: str, fresh: dict,
+                     top: int = 12) -> str:
+    """The ranked what-changed table (scripts/perf_diff.py) between a
+    leg's committed artifact and its fresh result — printed under a
+    failed ratchet so the failure names WHERE the regression lives
+    (goodput buckets, comm bytes, compile counts, latency percentiles,
+    kv/adapter pressure) instead of just the one gated scalar."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import perf_diff
+
+        rows = perf_diff.diff_leaves(
+            perf_diff.load_leaves(committed_path),
+            perf_diff.flatten(fresh),
+        )
+        return perf_diff.format_table(rows, top=top)
+    except Exception as e:  # noqa: BLE001 — attribution never masks the fail
+        return f"(perf attribution unavailable: {e})"
+
+
+def committed_watchtower_reference(repo: str = REPO):
+    """Registry-sweep rate from the committed watchtower artifact
+    (docs/watchtower_cpu.json), or None."""
+    path = os.path.join(repo, "docs", "watchtower_cpu.json")
+    try:
+        data = json.load(open(path))
+    except (OSError, ValueError):
+        return None
+    value = data.get("sample_ops_per_sec")
+    if not isinstance(value, (int, float)):
+        return None
+    return float(value), data
+
+
+def gate_watchtower(threshold: float, backend: str, fp: str) -> dict:
+    """The watchtower regression gate: the in-process TSDB + alert
+    engine + dashboard micro-bench (pure host), gated —
+
+    1. **Invariants** (hard): the injected TTFT regression fires the
+       ``quantile_over_time`` rule on the FIRST evaluation after the
+       regressed samples land (detection latency = one sample tick +
+       one eval tick, never a window), rings stay bounded at capacity,
+       and the dump -> load round-trip is exact.
+    2. **Trajectory/local baseline** on ``sample_ops_per_sec`` (full
+       registry sweeps per second — what the TSDB costs every publish
+       cadence), with the calibrate-then-ratchet fallback the parity
+       gate uses.  On a ratchet fail the perf_diff attribution table
+       vs the committed artifact prints with the verdict.
+    """
+    import bench
+
+    result = bench.bench_watchtower()
+    out = {
+        "sample_ops_per_sec": result["sample_ops_per_sec"],
+        "sample_mean_ms": (result.get("sample") or {}).get("mean_ms"),
+        "alert_eval_mean_ms":
+            (result.get("alert_eval") or {}).get("mean_ms"),
+        "dashboard_render_mean_ms":
+            (result.get("dashboard_render") or {}).get("mean_ms"),
+        "series": result.get("series"),
+        "detection": result.get("detection"),
+        "threshold": threshold,
+    }
+    if result.get("error"):
+        out.update(ok=False, decided_by="invariants",
+                   error=result["error"])
+        return out
+    committed = committed_watchtower_reference()
+    wt_key = f"{backend}_watchtower"
+    baseline = load_baseline(wt_key, fp)
+    decision = evaluate(
+        float(result["sample_ops_per_sec"]),
+        committed[0] if committed else None, baseline, threshold,
+    )
+    out.update(ok=decision["ok"], decided_by=decision["decided_by"])
+    if decision.get("note"):
+        out["note"] = decision["note"]
+    if decision["ok"]:
+        save_baseline(
+            wt_key, fp,
+            max(float(result["sample_ops_per_sec"]), baseline or 0.0),
+        )
+    else:
+        if "error" not in out:
+            out["error"] = (
+                f"watchtower {result['sample_ops_per_sec']} registry "
+                f"sweeps/s is >{threshold * 100:.0f}% below this "
+                f"machine's baseline {baseline}"
+            )
+        if committed:
+            out["attribution"] = perf_attribution(
+                os.path.join(REPO, "docs", "watchtower_cpu.json"),
+                result,
+            )
     return out
 
 
@@ -1662,6 +1762,10 @@ def main() -> int:
     parser.add_argument("--skip-deploy", action="store_true",
                         help="skip the live-rollout (canary deploy + "
                         "SLO-burn auto-rollback) gate")
+    parser.add_argument("--skip-watchtower", action="store_true",
+                        help="skip the watchtower TSDB/alert-engine gate "
+                        "(detection-latency invariant, registry-sweep "
+                        "ratchet vs docs/watchtower_cpu.json)")
     parser.add_argument("--changed-only", action="store_true",
                         help="map the files changed vs --changed-ref to "
                         "gate legs (legs_for_changes) and run only "
@@ -1868,6 +1972,23 @@ def main() -> int:
             f"{len(gp['configs'])} ledger configs agree, goodput "
             f"{gp['goodput_fraction']}, "
             f"{gp['post_warmup_compiles']} post-warmup compiles",
+            flush=True,
+        )
+    if not args.skip_watchtower and "watchtower" in selected:
+        wt = gate_watchtower(args.threshold, backend, fp)
+        print(json.dumps({"bench_gate_watchtower": wt}), flush=True)
+        if not wt["ok"]:
+            print(f"BENCH_GATE WATCHTOWER FAIL: {wt.get('error')}",
+                  flush=True)
+            if wt.get("attribution"):
+                print(wt["attribution"], flush=True)
+            return 1
+        print(
+            f"BENCH_GATE WATCHTOWER OK ({wt['decided_by']}): "
+            f"{wt['sample_ops_per_sec']} registry sweeps/s over "
+            f"{wt['series']} series, alert eval "
+            f"{wt['alert_eval_mean_ms']}ms, regression fired on first "
+            "eval",
             flush=True,
         )
     if not args.skip_elastic and "elastic" in selected:

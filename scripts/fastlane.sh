@@ -105,7 +105,22 @@
 # (bench_gate.py gate_deploy: deploy/rollback-verdict, rollback-
 # latency, identity, zero-recompile and fingerprint invariants hard,
 # post-rollback tokens/s ratchet vs docs/serving_deploy_cpu.json;
-# --skip-deploy to skip).
+# --skip-deploy to skip), and a watchtower smoke leg
+# (scripts/watchtower_smoke.py: the in-process TSDB + declarative
+# alert engine + live /dash dashboard against a real 3-process fleet —
+# byte identity and zero post-warmup compiles with the plane on, a
+# replica_slow chaos fault detected by a runtime-installed
+# severity-page AlertRule within one evaluation window, firing the
+# flight alert record and an incident bundle holding dashboard.html +
+# alerts.json) backed by the watchtower gate (bench_gate.py
+# gate_watchtower: first-eval detection / ring-bound / dump-roundtrip
+# invariants hard, registry-sweep ratchet vs docs/watchtower_cpu.json,
+# perf_diff attribution printed under a failed ratchet;
+# --skip-watchtower to skip).
+#
+# Every leg's wall-clock is upserted into docs/fastlane_timings.json
+# (scripts/perf_diff.py record) — diff two of those files with
+# scripts/perf_diff.py to attribute a fastlane slowdown to its leg.
 #
 # On a PR branch (HEAD != origin/main with origin/main resolvable) the
 # bench gate runs in --changed-only mode: the diff's files map to gate
@@ -119,76 +134,85 @@
 # last line (the tier-1 count, unchanged by the smoke leg).
 set -o pipefail
 cd "$(dirname "$0")/.." || exit 1
+
+# Per-leg wall-clock ledger: every leg upserts its seconds (and rc)
+# into docs/fastlane_timings.json — itself a perf_diff-able artifact,
+# so "fastlane got slow" attributes to a leg, not a feeling.
+TIMINGS=docs/fastlane_timings.json
+record_leg() {  # record_leg <name> <seconds> <rc>
+  python scripts/perf_diff.py record --file "$TIMINGS" \
+    --leg "$1" --seconds "$2" --rc "$3" >/dev/null 2>&1 || true
+}
+run_leg() {  # run_leg <name> <timeout_s> <script...>; returns leg rc
+  local name=$1 tmo=$2 t0 leg_rc
+  shift 2
+  t0=$SECONDS
+  timeout -k 10 "$tmo" env JAX_PLATFORMS=cpu "$@"
+  leg_rc=$?
+  record_leg "$name" $((SECONDS - t0)) $leg_rc
+  [ $leg_rc -ne 0 ] && echo "# $name leg FAILED (rc=$leg_rc)"
+  return $leg_rc
+}
+
 rm -f /tmp/_t1.log
+t0=$SECONDS
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
   -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
   -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
+record_leg tier1 $((SECONDS - t0)) $rc
 echo "# fault-injection smoke leg"
-timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/chaos_smoke.py
+run_leg chaos 240 python scripts/chaos_smoke.py
 smoke_rc=$?
-[ $smoke_rc -ne 0 ] && echo "# chaos smoke FAILED (rc=$smoke_rc)"
 echo "# telemetry smoke leg"
-timeout -k 10 240 env JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
+run_leg telemetry 240 python scripts/telemetry_smoke.py
 telemetry_rc=$?
-[ $telemetry_rc -ne 0 ] && echo "# telemetry smoke FAILED (rc=$telemetry_rc)"
 echo "# paged serving smoke leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/paged_serving_smoke.py
+run_leg paged_serving 300 python scripts/paged_serving_smoke.py
 paged_rc=$?
-[ $paged_rc -ne 0 ] && echo "# paged serving smoke FAILED (rc=$paged_rc)"
 echo "# mixed-precision / sharded-update smoke leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/mixed_smoke.py
+run_leg mixed 300 python scripts/mixed_smoke.py
 mixed_rc=$?
-[ $mixed_rc -ne 0 ] && echo "# mixed smoke FAILED (rc=$mixed_rc)"
 echo "# pipeline-schedule smoke leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/pipeline_smoke.py
+run_leg pipeline 300 python scripts/pipeline_smoke.py
 pipeline_rc=$?
-[ $pipeline_rc -ne 0 ] && echo "# pipeline smoke FAILED (rc=$pipeline_rc)"
 echo "# memory ledger / goodput / recompile smoke leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/memory_smoke.py
+run_leg memory 300 python scripts/memory_smoke.py
 memory_rc=$?
-[ $memory_rc -ne 0 ] && echo "# memory smoke FAILED (rc=$memory_rc)"
 echo "# serving-SLO smoke leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/slo_smoke.py
+run_leg slo 300 python scripts/slo_smoke.py
 slo_rc=$?
-[ $slo_rc -ne 0 ] && echo "# slo smoke FAILED (rc=$slo_rc)"
 echo "# batched-LoRA serving smoke leg"
-timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/lora_smoke.py
+run_leg lora 400 python scripts/lora_smoke.py
 lora_rc=$?
-[ $lora_rc -ne 0 ] && echo "# lora smoke FAILED (rc=$lora_rc)"
 echo "# disaggregated-router smoke leg"
-timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/router_smoke.py
+run_leg router 400 python scripts/router_smoke.py
 router_rc=$?
-[ $router_rc -ne 0 ] && echo "# router smoke FAILED (rc=$router_rc)"
 echo "# overload/failure-survival smoke leg"
-timeout -k 10 400 env JAX_PLATFORMS=cpu python scripts/overload_smoke.py
+run_leg overload 400 python scripts/overload_smoke.py
 overload_rc=$?
-[ $overload_rc -ne 0 ] && echo "# overload smoke FAILED (rc=$overload_rc)"
 echo "# elastic-training smoke leg (--quick: in-process reshape only;"
 echo "# the bench gate's gate_elastic runs the full cross-process leg)"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/elastic_smoke.py --quick
+run_leg elastic 300 python scripts/elastic_smoke.py --quick
 elastic_rc=$?
-[ $elastic_rc -ne 0 ] && echo "# elastic smoke FAILED (rc=$elastic_rc)"
 echo "# multi-process serving-fleet smoke leg"
-timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_smoke.py
+run_leg fleet 500 python scripts/fleet_smoke.py
 fleet_rc=$?
-[ $fleet_rc -ne 0 ] && echo "# fleet smoke FAILED (rc=$fleet_rc)"
 echo "# fleet observability-plane smoke leg"
-timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/fleet_obs_smoke.py
+run_leg fleet_obs 500 python scripts/fleet_obs_smoke.py
 fleet_obs_rc=$?
-[ $fleet_obs_rc -ne 0 ] && echo "# fleet obs smoke FAILED (rc=$fleet_obs_rc)"
+echo "# watchtower (TSDB + alert rules + dashboard) smoke leg"
+run_leg watchtower 500 python scripts/watchtower_smoke.py
+watchtower_rc=$?
 echo "# live-rollout (canary deploy + auto-rollback) smoke leg"
-timeout -k 10 500 env JAX_PLATFORMS=cpu python scripts/deploy_smoke.py
+run_leg deploy 500 python scripts/deploy_smoke.py
 deploy_rc=$?
-[ $deploy_rc -ne 0 ] && echo "# deploy smoke FAILED (rc=$deploy_rc)"
 echo "# Pallas kernel-layer smoke leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/kernels_smoke.py
+run_leg kernels 300 python scripts/kernels_smoke.py
 kernels_rc=$?
-[ $kernels_rc -ne 0 ] && echo "# kernels smoke FAILED (rc=$kernels_rc)"
 echo "# graft-lint static-analysis leg"
-timeout -k 10 300 env JAX_PLATFORMS=cpu python scripts/graft_lint.py
+run_leg graft_lint 300 python scripts/graft_lint.py
 lint_rc=$?
-[ $lint_rc -ne 0 ] && echo "# graft-lint FAILED (rc=$lint_rc)"
 echo "# ruff import-hygiene leg (when installed; graft-lint's"
 echo "# unused-import rule covers the F401 subset regardless)"
 if command -v ruff >/dev/null 2>&1; then
@@ -210,8 +234,10 @@ if [ -z "$FULL_GATE" ] \
   gate_args="--changed-only"
   echo "# (PR branch: bench gate in --changed-only mode; FULL_GATE=1 overrides)"
 fi
+t0=$SECONDS
 timeout -k 10 3000 env JAX_PLATFORMS=cpu python scripts/bench_gate.py $gate_args
 gate_rc=$?
+record_leg bench_gate $((SECONDS - t0)) $gate_rc
 [ $gate_rc -ne 0 ] && echo "# bench gate FAILED (rc=$gate_rc)"
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 [ $rc -eq 0 ] && rc=$smoke_rc
@@ -227,6 +253,7 @@ echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd
 [ $rc -eq 0 ] && rc=$elastic_rc
 [ $rc -eq 0 ] && rc=$fleet_rc
 [ $rc -eq 0 ] && rc=$fleet_obs_rc
+[ $rc -eq 0 ] && rc=$watchtower_rc
 [ $rc -eq 0 ] && rc=$deploy_rc
 [ $rc -eq 0 ] && rc=$kernels_rc
 [ $rc -eq 0 ] && rc=$lint_rc

@@ -229,10 +229,14 @@ def test_changed_only_leg_mapping():
     ) == {"elastic", "overload", "fleet"}
     # The observability spine rides the legs that read it — the SLO
     # plane, the fleet gate (which pins the federation/trace/bundle
-    # invariants), and the rollout gate's SLO-burn rollback.
+    # invariants), the rollout gate's SLO-burn rollback, and the
+    # watchtower gate (TSDB/alerts overhead + detection).
     assert bg.legs_for_changes(
         ["ml_trainer_tpu/telemetry/federation.py"]
-    ) == {"slo", "fleet", "deploy"}
+    ) == {"slo", "fleet", "deploy", "watchtower"}
+    assert bg.legs_for_changes(["docs/watchtower_cpu.json"]) == {
+        "watchtower"
+    }
     assert bg.legs_for_changes(["docs/fleet_obs_cpu.json"]) == {"fleet"}
     # Unmapped file or unknown diff -> run everything (fail safe).
     assert bg.legs_for_changes(["setup.py"]) == set(bg.ALL_LEGS)
